@@ -1,0 +1,21 @@
+# Tier-0 CPU smoke config — mirrors the reference's Colab CPU smoke test
+# (colab_nanoGPT_companion.ipynb:69-80): 2L/2H/64d, block 128, batch 16,
+# 50 iters, no compile-cache pressure. Proves the loop end-to-end fast.
+out_dir = "out/smoke_cpu"
+dataset = "shakespeare_char"
+device = "cpu"
+n_layer = 2
+n_head = 2
+n_embd = 64
+block_size = 128
+batch_size = 16
+max_iters = 50
+lr_decay_iters = 50
+eval_interval = 25
+eval_iters = 8
+log_interval = 10
+warmup_iters = 5
+learning_rate = 1e-3
+min_lr = 1e-4
+dropout = 0.0
+compute_dtype = "float32"  # CPU has no MXU; keep numerics simple

@@ -1,0 +1,21 @@
+# BASELINE config 3: GPT-2 124M on OpenWebText, single-pod 8-device data
+# parallel (v4-8) — the TPU analogue of workflow A's
+# `torchrun --standalone --nproc_per_node=N` (README.md:7).
+out_dir = "out/gpt2_124m_owt"
+dataset = "openwebtext"
+vocab_size = 50304  # GPT-2 50257 padded to 64 for the MXU
+n_layer = 12
+n_head = 12
+n_embd = 768
+block_size = 1024
+batch_size = 64  # global; 8 per chip on a v4-8
+gradient_accumulation_steps = 1
+dropout = 0.0
+max_iters = 600000
+lr_decay_iters = 600000
+eval_interval = 1000
+eval_iters = 100
+log_interval = 10
+learning_rate = 6e-4
+min_lr = 6e-5
+mesh_dp = -1  # all chips on the data axis

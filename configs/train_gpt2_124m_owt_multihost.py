@@ -1,0 +1,22 @@
+# BASELINE config 4: GPT-2 124M OpenWebText, multi-host (StatefulSet
+# nnodes=4, v5e-16) — the TPU analogue of workflow B (README.md:8, 62-72).
+# The entrypoint exports COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID;
+# this config only has to size the global batch for 16 chips.
+out_dir = "out/gpt2_124m_owt_mh"
+dataset = "openwebtext"
+vocab_size = 50304
+n_layer = 12
+n_head = 12
+n_embd = 768
+block_size = 1024
+batch_size = 128  # global across 16 chips
+gradient_accumulation_steps = 1
+dropout = 0.0
+max_iters = 600000
+lr_decay_iters = 600000
+eval_interval = 1000
+eval_iters = 100
+log_interval = 10
+learning_rate = 6e-4
+min_lr = 6e-5
+mesh_dp = -1

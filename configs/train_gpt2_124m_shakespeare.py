@@ -1,0 +1,18 @@
+# BASELINE config 2: GPT-2 124M on tiny-shakespeare, single chip
+# (Colab TPU / 1xA10 parity).
+out_dir = "out/gpt2_124m_shakespeare"
+dataset = "shakespeare_char"
+n_layer = 12
+n_head = 12
+n_embd = 768
+block_size = 1024
+batch_size = 12
+gradient_accumulation_steps = 1
+dropout = 0.0
+max_iters = 2000
+lr_decay_iters = 2000
+eval_interval = 500
+eval_iters = 50
+log_interval = 10
+learning_rate = 6e-4
+min_lr = 6e-5

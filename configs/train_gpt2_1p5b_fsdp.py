@@ -1,0 +1,25 @@
+# BASELINE config 5 (stretch): GPT-2 1.5B OpenWebText, FSDP across v5e-16 —
+# params/optimizer sharded over the fsdp axis (ZeRO-3 under jit), the one
+# place this build intentionally exceeds the reference's DDP-only scope
+# (SURVEY.md §2.5).
+out_dir = "out/gpt2_1p5b_fsdp"
+dataset = "openwebtext"
+vocab_size = 50304
+n_layer = 48
+n_head = 25
+n_embd = 1600
+block_size = 1024
+batch_size = 32
+gradient_accumulation_steps = 4
+dropout = 0.0
+max_iters = 100000
+lr_decay_iters = 100000
+eval_interval = 1000
+eval_iters = 50
+log_interval = 10
+learning_rate = 2e-4
+min_lr = 2e-5
+mesh_dp = 1
+mesh_fsdp = 16  # all 16 chips on the fsdp axis
+shard_params = True
+remat = True  # rematerialize blocks: 1.5B activations exceed HBM otherwise

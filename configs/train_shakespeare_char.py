@@ -1,0 +1,20 @@
+# BASELINE config 1: tiny-shakespeare char-level GPT (6L/6H/384d) — the
+# nanoGPT config/train_shakespeare_char.py equivalent the reference's k8s
+# jobs run (README.md:58, gh_sync.ps1:131).
+out_dir = "out/shakespeare_char"
+dataset = "shakespeare_char"
+n_layer = 6
+n_head = 6
+n_embd = 384
+block_size = 256
+batch_size = 64
+dropout = 0.2
+max_iters = 5000
+lr_decay_iters = 5000
+eval_interval = 250
+eval_iters = 200
+log_interval = 10
+warmup_iters = 100
+learning_rate = 1e-3
+min_lr = 1e-4
+beta2 = 0.99

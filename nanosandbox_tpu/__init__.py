@@ -1,0 +1,23 @@
+"""nanosandbox_tpu — a TPU-native distributed training framework.
+
+Rebuilds, idiomatically for JAX/XLA on TPU, the full capability set of the
+reference system (fxcawley/nanoSandbox, "DistTrain"): a nanoGPT-equivalent
+training core (reference delegated this to karpathy/nanoGPT, cloned at
+/root/reference/notebooks/colab_nanoGPT_companion.ipynb:39) plus the
+Kubernetes/TPU orchestration shell (reference README.md:18-24).
+
+Layout:
+  config     — dataclass config + nanoGPT-style configurator (config file +
+               --key=value CLI overrides; reference ipynb:71, 108)
+  models/    — decoder-only GPT in flax.linen, bf16 MXU-friendly
+  data/      — dataset preparation + memmapped per-host sharded batch loader
+  ops/       — Pallas TPU kernels (flash attention) with pure-XLA fallbacks
+  parallel/  — jax.sharding Mesh construction, DP/FSDP/TP sharding rules,
+               multi-host jax.distributed initialization from pod env
+  train      — iter-driven training loop (eval/log intervals, cosine LR,
+               checkpoints, TensorBoard scalars)
+  sample     — autoregressive generation from a checkpoint
+  utils/     — metric writers, tree utilities
+"""
+
+__version__ = "0.1.0"

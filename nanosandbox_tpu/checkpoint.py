@@ -1,0 +1,85 @@
+"""Checkpoint save/restore via Orbax, keeping the reference's /data contract.
+
+The reference delegates checkpointing to nanoGPT's ``out_dir`` torch.save
+(SURVEY.md §5 checkpoint/resume; --out_dir at ipynb:72,109), persisted on
+the PVC at /data so pod restarts resume (README.md:76, 96-97). Here the
+same layout contract holds — checkpoints under <out_dir>/ckpt — but the
+mechanism is Orbax multi-host array checkpointing: every host participates
+in save/restore of sharded arrays (vs. rank-0 torch.save), which is the
+only correct scheme once params are FSDP-sharded.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _manager(out_dir: str, keep: int = 3) -> ocp.CheckpointManager:
+    ckpt_dir = os.path.abspath(os.path.join(out_dir, "ckpt"))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
+    return ocp.CheckpointManager(ckpt_dir, options=options)
+
+
+class Checkpointer:
+    """Thin wrapper: save(step, state, extra) / restore latest."""
+
+    def __init__(self, out_dir: str, keep: int = 3):
+        self.out_dir = out_dir
+        self.mgr = _manager(out_dir, keep)
+
+    def save(self, step: int, state: Any, extra: dict | None = None,
+             wait: bool = False) -> None:
+        if step in (self.mgr.all_steps() or []):
+            return  # resume re-evals at the restored step; don't re-save
+        args = {"state": ocp.args.StandardSave(state)}
+        if extra is not None:
+            args["extra"] = ocp.args.JsonSave(extra)
+        self.mgr.save(step, args=ocp.args.Composite(**args))
+        if wait:
+            self.mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self.mgr.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None
+                ) -> tuple[Any, dict]:
+        step = step if step is not None else self.mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.out_dir}/ckpt")
+        try:
+            restored = self.mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    extra=ocp.args.JsonRestore(),
+                ),
+            )
+        except KeyError:  # checkpoint saved without an "extra" item
+            restored = self.mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state)),
+            )
+        extra = restored.get("extra") or {}
+        return restored["state"], dict(extra)
+
+    def close(self) -> None:
+        self.mgr.wait_until_finished()
+        self.mgr.close()
+
+
+def abstract_like(state: Any) -> Any:
+    """ShapeDtypeStruct tree (with shardings) for restore-into-sharded."""
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, np.ndarray):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+    return jax.tree.map(conv, state)

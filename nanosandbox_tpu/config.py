@@ -1,0 +1,221 @@
+"""Config system: dataclass defaults + nanoGPT-style "configurator".
+
+The reference pins the exact CLI contract in its Colab notebook
+(/root/reference/notebooks/colab_nanoGPT_companion.ipynb:71-78, 108-115):
+
+    python train.py <config_file.py> --key=value --key=value ...
+
+i.e. an optional positional python config file that overrides defaults, then
+``--key=value`` overrides on top (SURVEY.md §2.3 #27). We keep that contract
+exactly, but back it with a typed dataclass instead of module globals.
+
+TPU-specific additions beyond the reference's 14 exercised keys: mesh axis
+sizes (dp/fsdp/tp), dtype controls, and distributed-init settings. The
+reference's ``--device={cpu,cuda}`` (ipynb:77) becomes ``--device={cpu,tpu}``
+and maps to JAX platform selection; ``--compile`` maps to jax.jit on/off.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+@dataclass
+class TrainConfig:
+    # -- I/O (reference ipynb:72 --out_dir; README.md:76 /data layout) --
+    out_dir: str = "out"
+    data_dir: str = "data"  # root holding <dataset>/{train,val}.bin + meta.pkl
+    dataset: str = "shakespeare_char"
+    eval_interval: int = 2000
+    log_interval: int = 1
+    eval_iters: int = 200
+    eval_only: bool = False
+    always_save_checkpoint: bool = True
+    init_from: str = "scratch"  # 'scratch' | 'resume'
+    keep_checkpoints: int = 3
+
+    # -- model (reference ipynb:74-76: n_layer/n_head/n_embd/block_size/dropout) --
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    dropout: float = 0.0
+    bias: bool = False
+    vocab_size: int = 0  # 0 = take from dataset meta.pkl, else explicit
+
+    # -- optimizer / schedule (nanoGPT contract: cosine decay, AdamW, clip) --
+    learning_rate: float = 6e-4
+    max_iters: int = 600000
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+    decay_lr: bool = True
+    warmup_iters: int = 2000
+    lr_decay_iters: int = 600000
+    min_lr: float = 6e-5
+
+    # -- batch --
+    batch_size: int = 12  # per-step GLOBAL batch in sequences
+    gradient_accumulation_steps: int = 1
+
+    # -- system / TPU --
+    device: str = "auto"  # 'auto' | 'cpu' | 'tpu' (ref: --device={cpu,cuda})
+    compile: bool = True  # jax.jit the train step (ref: --compile)
+    seed: int = 1337
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"  # MXU-native
+    attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
+    remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+
+    # -- parallelism (mesh axes; SURVEY.md §2.5: DP required, FSDP stretch) --
+    mesh_dp: int = -1  # -1 = all remaining devices on the data axis
+    mesh_fsdp: int = 1
+    mesh_tp: int = 1
+    shard_params: bool = False  # FSDP: shard params/opt-state over fsdp axis
+
+    # -- distributed bootstrap (SURVEY.md §2.6; entrypoint derives these).
+    # Defaults mean "unset": the COORDINATOR_ADDRESS / NUM_PROCESSES /
+    # PROCESS_ID env vars (container/entrypoint.sh) then take effect.
+    coordinator_address: str = ""  # e.g. train-multipod-0.train-mp-headless:1234
+    num_processes: int = 0
+    process_id: int = -1
+
+    # -- logging --
+    tensorboard: bool = True
+    run_name: str = ""
+    log_dir: str = ""  # default: <out_dir>/runs (README.md:86 /data/runs)
+
+    def __post_init__(self) -> None:
+        if self.lr_decay_iters <= 0:
+            self.lr_decay_iters = self.max_iters
+
+    @property
+    def resolved_log_dir(self) -> str:
+        """TB/JSONL log root; tracks out_dir unless set explicitly
+        (README.md:86 contract: logs under /data/runs next to checkpoints)."""
+        return self.log_dir or os.path.join(self.out_dir, "runs")
+
+    @property
+    def sequences_per_iter(self) -> int:
+        """Sequences consumed per optimizer step (nanoGPT semantics:
+        batch_size is the micro-batch; accumulation multiplies data)."""
+        return self.gradient_accumulation_steps * self.batch_size
+
+    @property
+    def tokens_per_iter(self) -> int:
+        return self.sequences_per_iter * self.block_size
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_FIELD_TYPES = {f.name: f.type for f in fields(TrainConfig)}
+
+
+def _coerce(key: str, raw: str) -> Any:
+    """Coerce a --key=value string to the dataclass field's type.
+
+    Mirrors nanoGPT's configurator behavior: literal_eval first, fall back to
+    the raw string, and require bools to be spelled True/False.
+    """
+    try:
+        val = ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        val = raw
+    want = _FIELD_TYPES.get(key, "")
+    if want == "bool" and not isinstance(val, bool):
+        raise ValueError(f"--{key} expects True/False, got {raw!r}")
+    if want == "int" and isinstance(val, bool):
+        raise ValueError(f"--{key} expects int, got {raw!r}")
+    if want == "int" and isinstance(val, float) and val.is_integer():
+        val = int(val)
+    if want == "float" and isinstance(val, int) and not isinstance(val, bool):
+        val = float(val)
+    return val
+
+
+def load_config(argv: list[str] | None = None,
+                defaults: TrainConfig | None = None) -> TrainConfig:
+    """Build a TrainConfig from [config_file.py] --key=value... (ref ipynb:71).
+
+    The optional positional .py file is exec'd with the current config values
+    as globals; any names it (re)binds that match TrainConfig fields become
+    overrides. ``--key=value`` args are applied after, winning over the file.
+    Unknown keys raise, matching the configurator's strictness.
+    """
+    argv = list(argv or [])
+    cfg = defaults or TrainConfig()
+    overrides: dict[str, Any] = {}
+
+    positional = [a for a in argv if not a.startswith("--")]
+    flags = [a for a in argv if a.startswith("--")]
+    if len(positional) > 1:
+        raise ValueError(f"at most one config file allowed, got {positional}")
+
+    if positional:
+        path = positional[0]
+        if not path.endswith(".py"):
+            raise ValueError(f"config file must be .py, got {path!r}")
+        ns: dict[str, Any] = dict(cfg.to_dict())
+        with open(path, "r", encoding="utf-8") as f:
+            exec(compile(f.read(), path, "exec"), ns)
+        for k in _FIELD_TYPES:
+            if k in ns and ns[k] != getattr(cfg, k):
+                overrides[k] = ns[k]
+
+    for arg in flags:
+        body = arg[2:]
+        if "=" not in body:
+            raise ValueError(f"flag {arg!r} must be --key=value")
+        key, raw = body.split("=", 1)
+        if key not in _FIELD_TYPES:
+            raise ValueError(f"unknown config key: {key!r}")
+        overrides[key] = _coerce(key, raw)
+
+    cfg = cfg.replace(**overrides)
+    return cfg
+
+
+@dataclass
+class GPTConfig:
+    """Model-only view of the config, passed to models.gpt.GPT."""
+
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    vocab_size: int = 50304  # GPT-2 50257 padded up to a multiple of 64 for MXU
+    dropout: float = 0.0
+    bias: bool = False
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    attention_impl: str = "auto"
+    remat: bool = False
+
+    @classmethod
+    def from_train_config(cls, cfg: TrainConfig, vocab_size: int) -> "GPTConfig":
+        return cls(
+            n_layer=cfg.n_layer,
+            n_head=cfg.n_head,
+            n_embd=cfg.n_embd,
+            block_size=cfg.block_size,
+            vocab_size=vocab_size,
+            dropout=cfg.dropout,
+            bias=cfg.bias,
+            param_dtype=cfg.param_dtype,
+            compute_dtype=cfg.compute_dtype,
+            attention_impl=cfg.attention_impl,
+            remat=cfg.remat,
+        )
+
+
+def field_names() -> set[str]:
+    return set(_FIELD_TYPES)

@@ -1,0 +1,9 @@
+"""Data pipeline: prepare scripts -> {train,val}.bin + meta.pkl -> memmap loader.
+
+Contract from the reference (SURVEY.md §2.3 #28, ipynb:50-56): a prepare step
+emits uint16 token bins plus a meta.pkl vocab; the loader samples
+random-offset (block_size+1)-token windows from the memmap. Datasets live
+under <data_dir>/<dataset>/ (k8s: /data/datasets/<name>, gh_sync.ps1:126-127).
+"""
+
+from nanosandbox_tpu.data.loader import BinDataset, BatchLoader  # noqa: F401

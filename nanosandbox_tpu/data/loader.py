@@ -1,0 +1,137 @@
+"""Memmapped batch loader with per-host sharding and background prefetch.
+
+Reimplements nanoGPT's get_batch contract (random-offset windows from
+train.bin/val.bin memmaps; SURVEY.md §2.3 #28) with the two changes the TPU
+architecture demands (SURVEY.md §7 hard part (b)):
+
+  * **Per-host sharding** — under multi-host SPMD every process loads only
+    its slice of the global batch. Offsets are drawn from a stream keyed by
+    (seed, split, step, process_index) so hosts sample disjoint batches
+    without communicating (the DDP analogue was implicit per-rank RNG).
+  * **Background prefetch** — a worker thread stages the next batch while
+    the current step runs on the chip, hiding host-side gather latency.
+    The gather itself is native C++ (csrc/batchgen.cpp) when available.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+
+import numpy as np
+
+from nanosandbox_tpu.utils import native
+
+
+class BinDataset:
+    """A prepared dataset directory: {train,val}.bin (+ meta.pkl)."""
+
+    def __init__(self, data_dir: str, dataset: str):
+        self.dir = os.path.join(data_dir, dataset)
+        self.splits: dict[str, np.ndarray] = {}
+        for split in ("train", "val"):
+            path = os.path.join(self.dir, f"{split}.bin")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found — run `python -m nanosandbox_tpu.data.prepare "
+                    f"{dataset} --data_dir={data_dir}` first")
+            self.splits[split] = np.memmap(path, dtype=np.uint16, mode="r")
+        meta_path = os.path.join(self.dir, "meta.pkl")
+        self.meta: dict = {}
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                self.meta = pickle.load(f)
+
+    @property
+    def vocab_size(self) -> int:
+        # nanoGPT default: GPT-2 vocab padded to a multiple of 64 when no meta.
+        return int(self.meta.get("vocab_size", 50304))
+
+    def tokens(self, split: str) -> int:
+        return int(self.splits[split].shape[0])
+
+    def sample_batch(self, split: str, step: int, batch_size: int,
+                     block_size: int, *, seed: int = 1337,
+                     process_index: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Sample (x, y) int32 arrays of shape (batch_size, block_size)."""
+        data = self.splits[split]
+        width = block_size + 1
+        split_tag = 0 if split == "train" else 1
+        # Mix step/host/split into a single stream id for the native sampler.
+        stream = (np.uint64(step) * np.uint64(0x10001)
+                  + np.uint64(process_index) * np.uint64(2)
+                  + np.uint64(split_tag))
+        offsets = native.sample_offsets(seed, int(stream), data.shape[0],
+                                        width, batch_size)
+        windows = native.gather_windows(data, offsets, width)
+        xy = windows.astype(np.int32)
+        return xy[:, :-1], xy[:, 1:]
+
+
+class BatchLoader:
+    """Iterator over per-host batches with one-batch-ahead prefetch."""
+
+    def __init__(self, dataset: BinDataset, split: str, batch_size: int,
+                 block_size: int, *, seed: int = 1337, process_index: int = 0,
+                 num_processes: int = 1, start_step: int = 0,
+                 prefetch: bool = True):
+        if batch_size % num_processes:
+            raise ValueError(
+                f"global batch_size {batch_size} not divisible by "
+                f"num_processes {num_processes}")
+        self.dataset = dataset
+        self.split = split
+        self.global_batch_size = batch_size
+        self.local_batch_size = batch_size // num_processes
+        self.block_size = block_size
+        self.seed = seed
+        self.process_index = process_index
+        self.step = start_step
+        self.native = native.get_lib() is not None
+        self._queue: queue.Queue | None = None
+        if prefetch:
+            self._queue = queue.Queue(maxsize=2)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    def _load(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        return self.dataset.sample_batch(
+            self.split, step, self.local_batch_size, self.block_size,
+            seed=self.seed, process_index=self.process_index)
+
+    def _worker(self) -> None:
+        step = self.step
+        while not self._stop.is_set():
+            batch = self._load(step)
+            while not self._stop.is_set():
+                try:
+                    self._queue.put((step, batch), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            step += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._queue is not None:
+            step, batch = self._queue.get()
+            self.step = step + 1
+            return batch
+        batch = self._load(self.step)
+        self.step += 1
+        return batch
+
+    def close(self) -> None:
+        if self._queue is not None:
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=2)

@@ -1,0 +1,151 @@
+"""Dataset preparation: corpus -> train.bin / val.bin / meta.pkl.
+
+Reimplements the contract of nanoGPT's ``data/<dataset>/prepare.py`` as the
+reference exercises it (ipynb:50-56; k8s dataset Job, README.md:48-53,
+gh_sync.ps1:124-128): download/read a corpus, tokenize, write uint16 memmap
+bins with a 90/10 train/val split and a meta.pkl describing the vocab.
+
+Network access goes through the cluster proxy when configured (the proxy
+ConfigMap's env is honored automatically by urllib). When the network is
+unavailable, a local source file can be supplied, or — for smoke tests — a
+deterministic synthetic corpus is generated (the reference's scale-down
+testing philosophy, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import urllib.request
+
+import numpy as np
+
+from nanosandbox_tpu.data.tokenizer import ByteTokenizer, CharTokenizer, get_tokenizer
+
+TINY_SHAKESPEARE_URL = (
+    "https://raw.githubusercontent.com/karpathy/char-rnn/master/data/"
+    "tinyshakespeare/input.txt"
+)
+
+
+def _synthetic_corpus(n_chars: int = 200_000, seed: int = 1337) -> str:
+    """Deterministic pseudo-text for offline smoke tests (Tier-0, SURVEY §4)."""
+    rng = np.random.default_rng(seed)
+    words = ["the", "and", "lord", "king", "thou", "hath", "speak", "good",
+             "night", "come", "what", "shall", "more", "love", "death",
+             "crown", "sword", "blood", "heart", "light"]
+    parts: list[str] = []
+    total = 0
+    while total < n_chars:
+        n = int(rng.integers(4, 12))
+        sent = " ".join(words[int(i)] for i in rng.integers(0, len(words), n))
+        sent = sent.capitalize() + ".\n"
+        parts.append(sent)
+        total += len(sent)
+    return "".join(parts)[:n_chars]
+
+
+def fetch_corpus(out_path: str, url: str = TINY_SHAKESPEARE_URL,
+                 source_file: str | None = None,
+                 allow_synthetic: bool = True) -> str:
+    """Obtain the raw corpus text: local file > cached copy > download > synthetic."""
+    if source_file and os.path.exists(source_file):
+        with open(source_file, "r", encoding="utf-8") as f:
+            return f.read()
+    if os.path.exists(out_path):
+        with open(out_path, "r", encoding="utf-8") as f:
+            return f.read()
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            text = r.read().decode("utf-8")
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(text)
+        return text
+    except Exception:
+        if not allow_synthetic:
+            raise
+        return _synthetic_corpus()
+
+
+def write_bins(ids: np.ndarray, out_dir: str, meta: dict,
+               val_fraction: float = 0.1) -> dict:
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(ids)
+    split = int(n * (1 - val_fraction))
+    train_ids = ids[:split].astype(np.uint16)
+    val_ids = ids[split:].astype(np.uint16)
+    train_ids.tofile(os.path.join(out_dir, "train.bin"))
+    val_ids.tofile(os.path.join(out_dir, "val.bin"))
+    with open(os.path.join(out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    return {"train_tokens": len(train_ids), "val_tokens": len(val_ids),
+            "vocab_size": meta["vocab_size"]}
+
+
+def prepare_char_dataset(out_dir: str, source_file: str | None = None,
+                         url: str = TINY_SHAKESPEARE_URL,
+                         allow_synthetic: bool = True) -> dict:
+    """tiny-shakespeare char-level prep (ipynb:52-56 contract)."""
+    text = fetch_corpus(os.path.join(out_dir, "input.txt"), url=url,
+                        source_file=source_file,
+                        allow_synthetic=allow_synthetic)
+    tok = CharTokenizer.from_text(text)
+    ids = np.asarray(tok.encode(text), dtype=np.uint16)
+    return write_bins(ids, out_dir, tok.meta())
+
+
+def prepare_bpe_dataset(out_dir: str, source_files: list[str] | None = None,
+                        text: str | None = None, tokenizer: str = "gpt2",
+                        num_chars: int | None = None,
+                        allow_synthetic: bool = True) -> dict:
+    """OpenWebText-style prep (backlog item #22, gh_sync.ps1:144-148).
+
+    Reads source text files (or explicit text), tokenizes with GPT-2 BPE
+    (falling back to bytes offline), honors a size cap via ``num_chars``
+    (the backlog's "size via env").
+    """
+    if text is None:
+        chunks = []
+        for p in source_files or []:
+            with open(p, "r", encoding="utf-8") as f:
+                chunks.append(f.read())
+        text = "\n".join(chunks)
+    if not text:
+        if not allow_synthetic:
+            raise ValueError("no source text provided")
+        text = _synthetic_corpus(n_chars=num_chars or 1_000_000)
+    if num_chars:
+        text = text[:num_chars]
+    try:
+        tok = get_tokenizer(tokenizer)
+    except RuntimeError:
+        tok = ByteTokenizer()
+    ids = np.asarray(tok.encode(text), dtype=np.uint16)
+    return write_bins(ids, out_dir, tok.meta())
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="prepare dataset bins")
+    ap.add_argument("dataset", choices=["shakespeare_char", "openwebtext"])
+    ap.add_argument("--data_dir", default=os.environ.get("DATA_DIR", "data"))
+    ap.add_argument("--source_file", default=None)
+    ap.add_argument("--num_chars", type=int,
+                    default=int(os.environ.get("DATASET_NUM_CHARS", "0")) or None)
+    ap.add_argument("--tokenizer", default="gpt2")
+    args = ap.parse_args(argv)
+
+    out_dir = os.path.join(args.data_dir, args.dataset)
+    if args.dataset == "shakespeare_char":
+        stats = prepare_char_dataset(out_dir, source_file=args.source_file)
+    else:
+        stats = prepare_bpe_dataset(
+            out_dir, source_files=[args.source_file] if args.source_file else None,
+            tokenizer=args.tokenizer, num_chars=args.num_chars)
+    print(f"prepared {args.dataset} -> {out_dir}: {stats}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Tokenizers: char-level (meta.pkl contract), byte-level, GPT-2 BPE.
+
+The reference uses nanoGPT's char-level meta.pkl for tiny-shakespeare and
+tiktoken for GPT-2-scale datasets (ipynb:37, SURVEY.md §2.3 #31). The byte
+tokenizer is the zero-dependency offline fallback so the OpenWebText-style
+pipeline works in air-gapped clusters (proxy ConfigMap may not exist).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class CharTokenizer:
+    """Char-level tokenizer; vocabulary = sorted unique chars of the corpus."""
+
+    def __init__(self, stoi: dict[str, int], itos: dict[int, str]):
+        self.stoi = stoi
+        self.itos = itos
+        self.vocab_size = len(stoi)
+
+    @classmethod
+    def from_text(cls, text: str) -> "CharTokenizer":
+        chars = sorted(set(text))
+        stoi = {ch: i for i, ch in enumerate(chars)}
+        itos = {i: ch for i, ch in enumerate(chars)}
+        return cls(stoi, itos)
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "CharTokenizer":
+        return cls(meta["stoi"], meta["itos"])
+
+    def encode(self, text: str) -> list[int]:
+        return [self.stoi[c] for c in text]
+
+    def decode(self, ids) -> str:
+        return "".join(self.itos[int(i)] for i in ids)
+
+    def meta(self) -> dict:
+        return {"vocab_size": self.vocab_size, "stoi": self.stoi,
+                "itos": self.itos, "kind": "char"}
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer, vocab 256. Offline stand-in for BPE."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) for i in ids).decode("utf-8", errors="replace")
+
+    def meta(self) -> dict:
+        return {"vocab_size": self.vocab_size, "kind": "byte"}
+
+
+class GPT2Tokenizer:
+    """GPT-2 BPE via tiktoken (the reference's tokenizer dep, ipynb:37)."""
+
+    def __init__(self):
+        import tiktoken
+        self.enc = tiktoken.get_encoding("gpt2")
+        self.vocab_size = self.enc.n_vocab  # 50257
+
+    def encode(self, text: str) -> list[int]:
+        return self.enc.encode_ordinary(text)
+
+    def decode(self, ids) -> str:
+        return self.enc.decode([int(i) for i in ids])
+
+    def meta(self) -> dict:
+        return {"vocab_size": self.vocab_size, "kind": "gpt2"}
+
+
+def get_tokenizer(kind: str, meta: dict | None = None) -> Tokenizer:
+    if kind == "char":
+        if meta is None:
+            raise ValueError("char tokenizer needs meta.pkl contents")
+        return CharTokenizer.from_meta(meta)
+    if kind == "byte":
+        return ByteTokenizer()
+    if kind == "gpt2":
+        try:
+            return GPT2Tokenizer()
+        except Exception as e:  # offline / no BPE cache
+            raise RuntimeError(
+                "tiktoken gpt2 encoding unavailable (offline?); use the byte "
+                f"tokenizer or pre-populate the tiktoken cache: {e}") from e
+    raise ValueError(f"unknown tokenizer kind: {kind!r}")

@@ -1,0 +1,3 @@
+"""Model zoo: decoder-only GPT (the reference's single model family)."""
+
+from nanosandbox_tpu.models.gpt import GPT, count_params, cross_entropy_loss  # noqa: F401

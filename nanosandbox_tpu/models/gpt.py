@@ -1,0 +1,162 @@
+"""Decoder-only GPT in flax.linen, bf16-MXU-first.
+
+Reimplements the model contract the reference exercises from karpathy/nanoGPT
+(/root/reference/notebooks/colab_nanoGPT_companion.ipynb:71-78, 108-115 and
+SURVEY.md §2.3 #25): a decoder-only transformer configurable by
+``n_layer / n_head / n_embd / block_size / dropout`` with learned positional
+embeddings, pre-LayerNorm blocks, GELU MLP (4x), optional biases, weight
+tying between the token embedding and the LM head, and GPT-2 initialization
+(normal 0.02, residual projections scaled by 1/sqrt(2*n_layer)).
+
+TPU-first choices: parameters kept in float32, matmuls run in bfloat16
+(MXU-native) with float32 softmax/layernorm numerics; attention dispatches to
+the Pallas flash kernel on TPU (ops/attention.py); optional per-block
+jax.checkpoint (rematerialization) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.ops.attention import causal_attention
+
+
+def _dense_init(std: float = 0.02):
+    return nn.initializers.normal(stddev=std)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
+        cfg = self.cfg
+        B, T, C = x.shape
+        assert C % cfg.n_head == 0
+        head_dim = C // cfg.n_head
+        dtype = jnp.dtype(cfg.compute_dtype)
+
+        qkv = nn.Dense(3 * C, use_bias=cfg.bias, dtype=dtype,
+                       param_dtype=cfg.param_dtype,
+                       kernel_init=_dense_init(), name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, T, C) -> (B, H, T, D)
+        q = q.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+
+        attn_rng = None
+        if cfg.dropout > 0.0 and not deterministic:
+            attn_rng = self.make_rng("dropout")
+        y = causal_attention(q, k, v, impl=cfg.attention_impl,
+                             dropout_rate=0.0 if deterministic else cfg.dropout,
+                             dropout_rng=attn_rng)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+
+        proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
+        y = nn.Dense(C, use_bias=cfg.bias, dtype=dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=_dense_init(proj_std), name="c_proj")(y)
+        if cfg.dropout > 0.0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
+        cfg = self.cfg
+        C = x.shape[-1]
+        dtype = jnp.dtype(cfg.compute_dtype)
+        proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
+        h = nn.Dense(4 * C, use_bias=cfg.bias, dtype=dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=_dense_init(), name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(C, use_bias=cfg.bias, dtype=dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=_dense_init(proj_std), name="c_proj")(h)
+        if cfg.dropout > 0.0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
+                                       param_dtype=cfg.param_dtype, name=name)
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            ln("ln_1")(x).astype(cfg.compute_dtype), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            ln("ln_2")(x).astype(cfg.compute_dtype), deterministic)
+        return x
+
+
+class GPT(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, idx: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        B, T = idx.shape
+        if T > cfg.block_size:
+            raise ValueError(f"sequence length {T} > block_size {cfg.block_size}")
+
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                       embedding_init=_dense_init(),
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.block_size, cfg.n_embd,
+                       embedding_init=_dense_init(),
+                       param_dtype=cfg.param_dtype, name="wpe")
+
+        pos = jnp.arange(T)[None, :]
+        x = wte(idx) + wpe(pos)
+        if cfg.dropout > 0.0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        x = x.astype(cfg.compute_dtype)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        # Weight-tied LM head (nanoGPT ties lm_head.weight = wte.weight).
+        logits = wte.attend(x.astype(cfg.param_dtype))
+        return logits
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       ignore_index: int = -1) -> jax.Array:
+    """Mean next-token cross entropy; positions == ignore_index are masked."""
+    logits = logits.astype(jnp.float32)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def count_params(params: Any, include_embeddings: bool = True) -> int:
+    total = sum(x.size for x in jax.tree.leaves(params))
+    if not include_embeddings:
+        emb = params.get("params", params)
+        for name in ("wpe",):
+            node = emb.get(name)
+            if node is not None:
+                total -= sum(x.size for x in jax.tree.leaves(node))
+    return total

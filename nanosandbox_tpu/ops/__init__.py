@@ -1,0 +1,3 @@
+"""TPU ops: Pallas kernels with pure-XLA fallbacks."""
+
+from nanosandbox_tpu.ops.attention import causal_attention  # noqa: F401

@@ -1,0 +1,220 @@
+"""Causal self-attention: Pallas flash-attention forward for TPU + XLA fallback.
+
+The reference's training core (karpathy/nanoGPT, exercised via
+/root/reference/notebooks/colab_nanoGPT_companion.ipynb:71-78) relies on
+torch scaled_dot_product_attention/CUDA flash kernels. The TPU-native
+equivalent is a Pallas kernel compiled by Mosaic: the forward pass is an
+online-softmax (flash) kernel that never materializes the (T, T) score
+matrix in HBM, tiled to the MXU (128-lane blocks, f32 accumulation).
+
+The backward pass recomputes attention with the XLA implementation under
+jax.custom_vjp — at the reference's context lengths (block_size <= 1024,
+ipynb:74) the recompute is cheap and XLA fuses it well; a dedicated Pallas
+backward is a later optimization.
+
+Layouts: q, k, v are (B, H, T, D). D (head_dim) is padded to a multiple of
+128 lanes inside the Pallas path when needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+__all__ = ["causal_attention", "xla_attention", "flash_attention"]
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation (also the backward recompute path)
+# ---------------------------------------------------------------------------
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  *, causal: bool = True, sm_scale: float | None = None,
+                  dropout_rate: float = 0.0,
+                  dropout_rng: jax.Array | None = None) -> jax.Array:
+    """Plain attention; XLA fuses this adequately for short-T and CPU tests.
+
+    dropout_rate/dropout_rng apply inverted dropout to the softmax weights
+    (nanoGPT's attn_dropout; the reference model regularizes attention
+    probabilities as well as residuals).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    q32 = q.astype(jnp.float32) * sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k.astype(jnp.float32))
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash forward
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+                      block_k: int, sm_scale: float, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale            # (block_q, D)
+    seq_len = k_ref.shape[1]
+    head_dim = q_ref.shape[2]
+
+    if causal:
+        # Only iterate k blocks at or before this q block's frontier.
+        num_kb = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_kb = seq_len // block_k
+
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # (bq, 1)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, head_dim), jnp.float32),
+        jnp.full((block_q, 1), NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, m, l = lax.fori_loop(0, num_kb, body, init)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      causal: bool, sm_scale: float,
+                      block_q: int = 128, block_k: int = 128,
+                      interpret: bool = False) -> jax.Array:
+    B, H, T, D = q.shape
+    orig_D = D
+    # Pad head_dim to the 128-lane tile and T to the q/k block size.
+    pad_D = (-D) % 128
+    if pad_D:
+        pads = [(0, 0), (0, 0), (0, 0), (0, pad_D)]
+        q, k, v = (jnp.pad(x, pads) for x in (q, k, v))
+        D += pad_D
+    block_q = min(block_q, max(T, 8))
+    block_k = min(block_k, max(T, 8))
+    pad_T = (-T) % max(block_q, block_k)
+    if pad_T:
+        # Padded key rows would attract softmax mass for padded query rows
+        # only; padded queries are sliced off below, and causal masking keeps
+        # real queries from seeing padded (future) keys.
+        pads = [(0, 0), (0, 0), (0, pad_T), (0, 0)]
+        q, k, v = (jnp.pad(x, pads) for x in (q, k, v))
+        if not causal:
+            raise ValueError("non-causal pallas path requires T % block == 0")
+    Tp = q.shape[2]
+
+    qf = q.reshape(B * H, Tp, D)
+    kf = k.reshape(B * H, Tp, D)
+    vf = v.reshape(B * H, Tp, D)
+
+    grid = (B * H, Tp // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+        sm_scale=sm_scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tp, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(B, H, Tp, D)
+    if pad_T:
+        out = out[:, :, :T, :]
+    if pad_D:
+        out = out[..., :orig_D]
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
+                    interpret: bool = False):
+    """Flash forward (Pallas) with XLA-recompute backward."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                             interpret=interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    o = _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                          interpret=interpret)
+    return o, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, interpret, res, do):
+    q, k, v = res
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal,
+                                         sm_scale=sm_scale), q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                     impl: str = "auto", sm_scale: float | None = None,
+                     dropout_rate: float = 0.0,
+                     dropout_rng: jax.Array | None = None) -> jax.Array:
+    """Causal attention over (B, H, T, D) tensors.
+
+    impl: 'auto' (Pallas on TPU, XLA elsewhere), 'pallas', 'pallas_interpret'
+    (for CPU tests), or 'xla'. Attention-probability dropout is only
+    expressible in the XLA path; when active it overrides the impl choice
+    (flash stays the inference/no-dropout fast path).
+    """
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        return xla_attention(q, k, v, causal=True, sm_scale=sm_scale,
+                             dropout_rate=dropout_rate,
+                             dropout_rng=dropout_rng)
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=True, sm_scale=sm_scale)
+    if impl == "pallas":
+        return flash_attention(q, k, v, True, sm_scale, False)
+    if impl == "pallas_interpret":
+        return flash_attention(q, k, v, True, sm_scale, True)
+    raise ValueError(f"unknown attention impl: {impl!r}")

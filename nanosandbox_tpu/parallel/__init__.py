@@ -1,0 +1,14 @@
+"""Parallelism: device mesh, sharding rules, multi-host bootstrap.
+
+SURVEY.md §2.5-2.6: the reference's parallelism is DDP-only (NCCL allreduce
+via torchrun); the TPU build expresses DP as a sharded batch axis under jit
+over a `jax.sharding.Mesh`, FSDP (BASELINE config 5) as parameter sharding
+on an `fsdp` axis, and leaves a `model` (TP) axis open. Collectives are
+inserted by XLA's SPMD partitioner and ride ICI within a slice / DCN across
+slices — there is no NCCL analogue to tune (README.md:101's NCCL env notes
+map to nothing; documented in docs/playbook.md).
+"""
+
+from nanosandbox_tpu.parallel.mesh import make_mesh, batch_sharding  # noqa: F401
+from nanosandbox_tpu.parallel.sharding import param_shardings  # noqa: F401
+from nanosandbox_tpu.parallel.distributed import maybe_initialize_distributed  # noqa: F401

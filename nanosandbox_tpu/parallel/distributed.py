@@ -1,0 +1,71 @@
+"""Multi-host bootstrap: jax.distributed.initialize from pod environment.
+
+Replaces the reference's torchrun rendezvous (SURVEY.md §2.6): there,
+container/entrypoint.sh derived NODE_RANK from the StatefulSet pod ordinal
+and MASTER_ADDR from the headless Service DNS (README.md:21, 102, 120). The
+same mechanism survives here with different names: the entrypoint exports
+
+  COORDINATOR_ADDRESS  e.g. train-multipod-0.train-mp-headless:12355
+  NUM_PROCESSES        StatefulSet replica count
+  PROCESS_ID           pod ordinal (parsed from hostname)
+
+and every host runs the *same* program (SPMD — no launcher forking
+workers). A missing pod hangs initialize(), the analogue of the reference's
+rendezvous-DNS failure mode (README.md:120); initialization_timeout turns
+that hang into a diagnosable error.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+
+_INITIALIZED = False
+
+
+def derive_process_id_from_hostname(hostname: str | None = None) -> int | None:
+    """StatefulSet pods are named <name>-<ordinal> (README.md:69-71)."""
+    hostname = hostname if hostname is not None else os.environ.get(
+        "HOSTNAME", "")
+    m = re.search(r"-(\d+)$", hostname)
+    return int(m.group(1)) if m else None
+
+
+def maybe_initialize_distributed(coordinator_address: str = "",
+                                 num_processes: int = 0,
+                                 process_id: int = -1,
+                                 timeout_s: int = 300) -> bool:
+    """Initialize multi-host JAX if configured; no-op for single-process.
+
+    Resolution order per field: explicit arg > env var > hostname-derived.
+    Returns True when running multi-process.
+    """
+    global _INITIALIZED
+    coord = coordinator_address or os.environ.get("COORDINATOR_ADDRESS", "")
+    nproc = num_processes if num_processes > 0 else int(
+        os.environ.get("NUM_PROCESSES", "0"))
+    pid = process_id
+    if pid < 0:
+        pid = int(os.environ.get("PROCESS_ID", "-1"))
+    if pid < 0:
+        derived = derive_process_id_from_hostname()
+        pid = derived if derived is not None else 0
+
+    if not coord or nproc <= 1:
+        return False
+    if _INITIALIZED:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        initialization_timeout=timeout_s,
+    )
+    _INITIALIZED = True
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,54 @@
+"""Device mesh construction and batch sharding.
+
+Axes: ``data`` (pure data parallel), ``fsdp`` (data parallel + parameter
+sharding — ZeRO-3 style), ``model`` (tensor parallel, open for scale-up).
+The batch is sharded over (data, fsdp) jointly; params are replicated over
+``data``, sharded over ``fsdp`` when cfg.shard_params, and sharded over
+``model`` per the TP rules in sharding.py.
+
+Replaces the reference's torchrun process-group topology (SURVEY.md §2.5):
+workflow A (1 pod × 3 GPU) maps to a single-host mesh over local devices;
+workflow B (3 pods × 1 GPU) maps to the same mesh spanning hosts after
+jax.distributed.initialize.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "model")
+
+
+def make_mesh(mesh_dp: int = -1, mesh_fsdp: int = 1, mesh_tp: int = 1,
+              devices: list | None = None) -> Mesh:
+    """Build a (data, fsdp, model) mesh over all devices.
+
+    mesh_dp = -1 means "all devices not claimed by fsdp/model". Axis order
+    puts ``model`` innermost so TP collectives ride the fastest ICI links,
+    then ``fsdp``, then ``data`` outermost (its allreduce tolerates DCN).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if mesh_fsdp <= 0 or mesh_tp <= 0:
+        raise ValueError("mesh_fsdp and mesh_tp must be positive")
+    if mesh_dp == -1:
+        if n % (mesh_fsdp * mesh_tp):
+            raise ValueError(
+                f"{n} devices not divisible by fsdp*tp={mesh_fsdp * mesh_tp}")
+        mesh_dp = n // (mesh_fsdp * mesh_tp)
+    if mesh_dp * mesh_fsdp * mesh_tp != n:
+        raise ValueError(
+            f"mesh {mesh_dp}x{mesh_fsdp}x{mesh_tp} != {n} devices")
+    dev_array = np.asarray(devices).reshape(mesh_dp, mesh_fsdp, mesh_tp)
+    return Mesh(dev_array, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over data+fsdp jointly; sequence dim replicated."""
+    return NamedSharding(mesh, P(("data", "fsdp"), None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

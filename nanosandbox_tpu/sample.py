@@ -1,0 +1,117 @@
+"""Autoregressive sampling from a trained checkpoint.
+
+nanoGPT ships sample.py alongside train.py (the reference exercises the
+trainer only, SURVEY.md §2.3, but generation is part of the nanoGPT
+capability surface a user expects). TPU-native decode: a lax.scan over
+positions with a fixed block_size context window — fully jit-compiled,
+no Python control flow per token.
+
+    python -m nanosandbox_tpu.sample --out_dir=out --start="\\n" \
+        --num_samples=3 --max_new_tokens=200 --temperature=0.8 --top_k=40
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from functools import partial
+
+
+def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
+             top_k: int, rng, block_size: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, T0 = idx.shape
+    total = max(T0 + max_new_tokens, block_size + 1)
+    # Fixed-shape buffer so the whole decode is one compiled scan; causal
+    # attention makes the zero-padding beyond the frontier harmless.
+    buf = jnp.zeros((B, total), jnp.int32).at[:, :T0].set(idx)
+
+    def step(carry, i):
+        # i = position of the last known token; we sample position i+1.
+        buf, rng = carry
+        start = jnp.clip(i + 1 - block_size, 0, total - block_size)
+        ctx = lax.dynamic_slice(buf, (0, start), (B, block_size))
+        logits = model.apply({"params": params}, ctx, deterministic=True)
+        pos_in_ctx = i - start
+        logits_i = logits[jnp.arange(B), pos_in_ctx, :] / temperature
+        if top_k > 0:
+            k = min(top_k, logits_i.shape[-1])  # nanoGPT clamps to vocab
+            kth = jnp.sort(logits_i, axis=-1)[:, -k][:, None]
+            logits_i = jnp.where(logits_i < kth, -1e30, logits_i)
+        rng, sub = jax.random.split(rng)
+        nxt = jax.random.categorical(sub, logits_i)
+        buf = buf.at[:, i + 1].set(nxt.astype(jnp.int32))
+        return (buf, rng), None
+
+    (buf, _), _ = lax.scan(step, (buf, rng),
+                           jnp.arange(T0 - 1, T0 - 1 + max_new_tokens))
+    return buf[:, :T0 + max_new_tokens]
+
+
+def main(argv: list[str] | None = None) -> list[str]:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out_dir", default="out")
+    ap.add_argument("--data_dir", default="data")
+    ap.add_argument("--dataset", default="shakespeare_char")
+    ap.add_argument("--start", default="\n")
+    ap.add_argument("--num_samples", type=int, default=1)
+    ap.add_argument("--max_new_tokens", type=int, default=200)
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--top_k", type=int, default=40)
+    ap.add_argument("--seed", type=int, default=1337)
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.checkpoint import Checkpointer
+    from nanosandbox_tpu.config import GPTConfig, TrainConfig
+    from nanosandbox_tpu.data.loader import BinDataset
+    from nanosandbox_tpu.data.tokenizer import get_tokenizer
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.train import Trainer, make_optimizer
+
+    ckpt = Checkpointer(args.out_dir)
+    step = ckpt.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {args.out_dir}/ckpt")
+    # Restore config first to rebuild the model/optimizer shapes.
+    import orbax.checkpoint as ocp
+    restored_extra = ckpt.mgr.restore(
+        step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+    cfg = TrainConfig(**{**restored_extra["extra"]["config"],
+                         "device": "auto", "init_from": "resume",
+                         "out_dir": args.out_dir,
+                         "data_dir": args.data_dir})
+    trainer = Trainer(cfg)
+    state, _ = ckpt.restore(trainer.abstract_state, step)
+    params = state["params"]
+
+    ds = BinDataset(args.data_dir, args.dataset)
+    meta = ds.meta
+    tok = get_tokenizer(meta.get("kind", "char"), meta)
+    start_ids = tok.encode(args.start) or [0]
+
+    idx = jnp.asarray([start_ids] * args.num_samples, jnp.int32)
+    rng = jax.random.key(args.seed)
+    gen = jax.jit(partial(generate, trainer.model,
+                          max_new_tokens=args.max_new_tokens,
+                          temperature=args.temperature, top_k=args.top_k,
+                          block_size=cfg.block_size))
+    out = gen(params, idx, rng=rng)
+    texts = []
+    for row in out:
+        text = tok.decode([int(t) for t in row])
+        texts.append(text)
+        print(text)
+        print("---------------")
+    return texts
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Utilities: native library loading, metric writers, tree helpers."""

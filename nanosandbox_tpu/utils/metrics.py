@@ -1,0 +1,58 @@
+"""Metric logging: stdout, JSONL, and TensorBoard event files.
+
+Mirrors the reference's three observability mechanisms (SURVEY.md §5): (1)
+stdout every log_interval iters consumed via `kubectl logs -f`
+(README.md:59); (2) TensorBoard event files under /data/runs, exported with
+`kubectl cp` (README.md:74-87); (3) eval-loss lines every eval_interval.
+JSONL is added as a machine-readable mirror of stdout.
+
+Only process 0 writes (multi-host SPMD: every host computes identical
+globals, so one writer suffices — the analogue of DDP rank-0 logging).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+class MetricsWriter:
+    def __init__(self, log_dir: str, run_name: str = "", enabled: bool = True,
+                 tensorboard: bool = True):
+        self.enabled = enabled
+        self.tb = None
+        self.jsonl = None
+        if not enabled:
+            return
+        run = run_name or time.strftime("%Y%m%d-%H%M%S")
+        self.dir = os.path.join(log_dir, run)
+        os.makedirs(self.dir, exist_ok=True)
+        self.jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a",
+                          buffering=1)
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.tb = SummaryWriter(log_dir=self.dir)
+            except Exception:
+                self.tb = None
+
+    def log(self, step: int, scalars: dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        rec = {"step": step, "time": time.time(), **scalars}
+        self.jsonl.write(json.dumps(rec) + "\n")
+        if self.tb is not None:
+            for k, v in scalars.items():
+                try:
+                    self.tb.add_scalar(k, float(v), step)
+                except (TypeError, ValueError):
+                    pass
+
+    def close(self) -> None:
+        if self.jsonl is not None:
+            self.jsonl.close()
+        if self.tb is not None:
+            self.tb.flush()
+            self.tb.close()

@@ -1,0 +1,107 @@
+"""ctypes loader for the native batch-gather library (csrc/batchgen.cpp).
+
+Compiles the shared library on first use with g++ (cached next to the
+source); every entry point has a pure-numpy fallback so the framework works
+on machines without a toolchain. pybind11 is not in the image, so the
+binding is plain ctypes over an ``extern "C"`` surface.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "batchgen.cpp")
+_LIB_PATH = os.path.join(_REPO_ROOT, "csrc", "libbatchgen.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-fopenmp",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        try:  # retry without -march/-fopenmp for maximum portability
+            subprocess.run(["g++", "-O3", "-fPIC", "-shared", _SRC,
+                            "-o", _LIB_PATH],
+                           check=True, capture_output=True, timeout=120)
+            return True
+        except Exception:
+            return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            if not os.path.exists(_SRC) or not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.gather_windows_u16.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+            lib.sample_offsets.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+            _lib = lib
+        except OSError:
+            _load_failed = True
+    return _lib
+
+
+def gather_windows(data: np.ndarray, offsets: np.ndarray, width: int) -> np.ndarray:
+    """Gather ``len(offsets)`` windows of ``width`` uint16 tokens from data."""
+    assert data.dtype == np.uint16
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    B = len(offsets)
+    out = np.empty((B, width), dtype=np.uint16)
+    lib = get_lib()
+    if lib is not None:
+        lib.gather_windows_u16(
+            data.ctypes.data_as(ctypes.c_void_p), data.shape[0],
+            offsets.ctypes.data_as(ctypes.c_void_p), B, width,
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    # numpy fallback: fancy-index a window per row
+    idx = offsets[:, None] + np.arange(width)[None, :]
+    np.take(data, idx, out=out)
+    return out
+
+
+def sample_offsets(seed: int, stream: int, n_tokens: int, width: int,
+                   batch: int) -> np.ndarray:
+    """Deterministic offsets in [0, n_tokens - width]; native or numpy path.
+
+    Note: the two paths use different RNGs, so determinism holds per-path.
+    The loader records which path is active (BatchLoader.native).
+    """
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty(batch, dtype=np.int64)
+        lib.sample_offsets(seed, stream, n_tokens, width, batch,
+                           out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    # stream goes into the 128-bit Philox KEY (not the counter): adjacent
+    # stream ids get unrelated keystreams, so per-host/per-step draws never
+    # overlap the way nearby counter offsets would.
+    key = (int(seed) << 64) | (int(stream) & ((1 << 64) - 1))
+    rng = np.random.Generator(np.random.Philox(key=key))
+    return rng.integers(0, n_tokens - width + 1, size=batch, dtype=np.int64)

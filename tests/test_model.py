@@ -1,0 +1,109 @@
+"""GPT model tests: shapes, tying, causality, init scale, param count."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT, count_params, cross_entropy_loss
+
+
+def tiny(**kw):
+    base = dict(n_layer=2, n_head=2, n_embd=32, block_size=16, vocab_size=65,
+                dropout=0.0, compute_dtype="float32", attention_impl="xla")
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny()
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    return model, params, cfg
+
+
+def test_forward_shape(model_and_params):
+    model, params, cfg = model_and_params
+    x = jnp.zeros((3, 16), jnp.int32)
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (3, 16, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_weight_tying(model_and_params):
+    _, params, _ = model_and_params
+    assert "lm_head" not in params  # head reuses wte.attend
+
+
+def test_causality(model_and_params):
+    model, params, _ = model_and_params
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 65, (1, 16))
+    x2 = x.copy()
+    x2[0, 10:] = rng.integers(0, 65, 6)  # perturb the future
+    l1 = model.apply({"params": params}, jnp.asarray(x, jnp.int32))
+    l2 = model.apply({"params": params}, jnp.asarray(x2, jnp.int32))
+    np.testing.assert_allclose(np.asarray(l1[0, :10]), np.asarray(l2[0, :10]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, 10:]), np.asarray(l2[0, 10:]))
+
+
+def test_gpt2_124m_param_count():
+    cfg = GPTConfig(n_layer=12, n_head=12, n_embd=768, block_size=1024,
+                    vocab_size=50304, bias=False)
+    model = GPT(cfg)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    n = count_params(abstract["params"])
+    # nanoGPT reports 124.34M for GPT-2 with wpe included at vocab 50304.
+    assert 120e6 < n < 130e6
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4, 7)),
+                         jnp.float32)
+    targets = jnp.asarray([[1, 2, 3, -1], [0, 6, -1, -1]])
+    loss = cross_entropy_loss(logits, targets)
+    logp = jax.nn.log_softmax(logits, -1)
+    manual = []
+    for b in range(2):
+        for t in range(4):
+            if int(targets[b, t]) != -1:
+                manual.append(-float(logp[b, t, int(targets[b, t])]))
+    assert float(loss) == pytest.approx(np.mean(manual), rel=1e-5)
+
+
+def test_dropout_requires_rng_and_varies():
+    cfg = tiny(dropout=0.5)
+    model = GPT(cfg)
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = model.init({"params": jax.random.key(0),
+                         "dropout": jax.random.key(1)}, x,
+                        deterministic=False)["params"]
+    a = model.apply({"params": params}, x, deterministic=False,
+                    rngs={"dropout": jax.random.key(2)})
+    b = model.apply({"params": params}, x, deterministic=False,
+                    rngs={"dropout": jax.random.key(3)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    c = model.apply({"params": params}, x, deterministic=True)
+    d = model.apply({"params": params}, x, deterministic=True)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
+
+def test_remat_matches(model_and_params):
+    model, params, cfg = model_and_params
+    rcfg = tiny(remat=True)
+    rmodel = GPT(rcfg)
+    x = jnp.zeros((2, 16), jnp.int32)
+    a = model.apply({"params": params}, x)
+    b = rmodel.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_block_size_overflow_raises(model_and_params):
+    model, params, _ = model_and_params
+    with pytest.raises(ValueError, match="block_size"):
+        model.apply({"params": params}, jnp.zeros((1, 17), jnp.int32))
