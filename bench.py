@@ -1,0 +1,123 @@
+"""Benchmark: GPT-2 124M training throughput, tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no numbers (SURVEY.md §6; BASELINE.json
+"published": {}), so the parity target is nanoGPT GPT-2 124M tokens/sec on
+one NVIDIA A10 — the reference's per-device hardware (README.md:5,13).
+Public nanoGPT runs with torch.compile + flash attention put that at
+~22k tokens/sec/A10 for the 124M/1024-ctx config; vs_baseline is measured
+tokens/sec/chip divided by that estimate (>1.0 beats the reference's
+per-device hardware).
+
+Usage: python bench.py [--quick] [--batch_size=N] [--iters=N]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+A10_BASELINE_TOKS_PER_SEC = 22_000.0
+
+
+def main(argv: list[str]) -> dict:
+    quick = "--quick" in argv
+    kv = dict(a.lstrip("-").split("=", 1) for a in argv if "=" in a)
+    import numpy as np
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_chips = len(jax.devices())
+
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    import os
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="bench_")
+    data_dir = os.path.join(tmp, "data")
+    from nanosandbox_tpu.data.prepare import prepare_char_dataset
+
+    prepare_char_dataset(os.path.join(data_dir, "shakespeare_char"),
+                         allow_synthetic=True,
+                         url="http://invalid.localhost/offline")
+
+    if on_tpu:
+        cfg = TrainConfig(
+            out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+            dataset="shakespeare_char", vocab_size=50304,
+            n_layer=12, n_head=12, n_embd=768, block_size=1024,
+            batch_size=int(kv.get("batch_size", 16)) * n_chips,
+            max_iters=0, eval_interval=0, log_interval=1,
+            dropout=0.0, compute_dtype="bfloat16",
+            attention_impl="auto", tensorboard=False)
+        warmup, iters = (2, 5) if quick else (3, 20)
+    else:  # CPU fallback keeps the bench runnable anywhere
+        cfg = TrainConfig(
+            out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+            dataset="shakespeare_char",
+            n_layer=2, n_head=2, n_embd=64, block_size=128,
+            batch_size=8, max_iters=0, eval_interval=0,
+            dropout=0.0, compute_dtype="float32", tensorboard=False)
+        warmup, iters = (1, 3)
+
+    cfg = cfg.replace(batch_size=int(kv.get("batch_size", cfg.batch_size)))
+    iters = int(kv.get("iters", iters))
+
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=True)
+    rng = jax.random.key(0)
+
+    try:
+        for i in range(warmup):
+            xb, yb = next(loader)
+            state, m = train_step(state, trainer.to_global(xb),
+                                  trainer.to_global(yb), rng)
+        float(m["loss"])  # hard sync: some PJRT transports make
+        # block_until_ready a no-op; a scalar readback always waits.
+
+        times = []
+        loss = 0.0
+        for i in range(iters):
+            xb, yb = next(loader)
+            t0 = time.perf_counter()
+            state, m = train_step(state, trainer.to_global(xb),
+                                  trainer.to_global(yb), rng)
+            loss = float(m["loss"])
+            times.append(time.perf_counter() - t0)
+    finally:
+        loader.close()
+
+    med = float(np.median(times))
+    toks_per_sec = cfg.tokens_per_iter / med
+    toks_per_chip = toks_per_sec / n_chips
+    mfu = trainer.flops_per_iter() / med / trainer.peak_flops()
+
+    result = {
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
+        else "tiny_train_tokens_per_sec_per_chip_cpu",
+        "value": round(toks_per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(toks_per_chip / A10_BASELINE_TOKS_PER_SEC, 3),
+        "extra": {
+            "backend": jax.default_backend(),
+            "n_chips": n_chips,
+            "batch_size": cfg.batch_size,
+            "block_size": cfg.block_size,
+            "median_step_ms": round(med * 1000, 2),
+            "mfu": round(mfu, 4),
+            "loss": round(loss, 4),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
