@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Container entrypoint: derive the multi-host JAX process identity from the
+# Kubernetes StatefulSet pod identity, then exec the trainer.
+#
+# This is the TPU-native successor of the reference's container/entrypoint.sh
+# (described at /root/reference/README.md:21,102: "Sets NODE_RANK from
+# StatefulSet ordinal for multi-Pod DDP"). The mechanism survives with new
+# names: instead of exporting NODE_RANK/MASTER_ADDR/MASTER_PORT for torchrun,
+# we export PROCESS_ID / NUM_PROCESSES / COORDINATOR_ADDRESS for
+# jax.distributed.initialize (nanosandbox_tpu/parallel/distributed.py).
+# Every pod runs the SAME program (SPMD) — there is no launcher forking
+# worker processes the way torchrun did.
+#
+#   PROCESS_ID           <- trailing ordinal of the pod hostname
+#                           (train-multipod-2 -> 2); 0 if no ordinal.
+#   NUM_PROCESSES        <- $NUM_PROCESSES (set by the StatefulSet manifest
+#                           to spec.replicas); defaults to 1 (single-pod).
+#   COORDINATOR_ADDRESS  <- pod-0 of the StatefulSet via the headless
+#                           Service DNS (reference README.md:120 used the
+#                           same DNS name as MASTER_ADDR).
+#
+# DRY_RUN=1 prints the derived environment instead of exec'ing — used by
+# tests/test_deploy.py to pin the rank-derivation contract.
+set -euo pipefail
+
+STATEFULSET_NAME="${STATEFULSET_NAME:-train-multipod}"
+HEADLESS_SERVICE="${HEADLESS_SERVICE:-train-mp-headless}"
+COORDINATOR_PORT="${COORDINATOR_PORT:-12355}"
+NUM_PROCESSES="${NUM_PROCESSES:-1}"
+
+hostname_value="${HOSTNAME:-$(hostname)}"
+
+# Trailing "-<digits>" of the hostname is the StatefulSet ordinal.
+if [[ "${PROCESS_ID:-}" == "" ]]; then
+  if [[ "$hostname_value" =~ -([0-9]+)$ ]]; then
+    PROCESS_ID="${BASH_REMATCH[1]}"
+  else
+    PROCESS_ID=0
+  fi
+fi
+
+# Rendezvous point: pod 0's stable DNS name under the headless Service.
+# Within-namespace short form resolves via cluster DNS search domains.
+if [[ "${COORDINATOR_ADDRESS:-}" == "" ]]; then
+  if (( NUM_PROCESSES > 1 )); then
+    COORDINATOR_ADDRESS="${STATEFULSET_NAME}-0.${HEADLESS_SERVICE}:${COORDINATOR_PORT}"
+  else
+    COORDINATOR_ADDRESS=""
+  fi
+fi
+
+export PROCESS_ID NUM_PROCESSES COORDINATOR_ADDRESS
+
+if [[ "${DRY_RUN:-0}" == "1" ]]; then
+  echo "PROCESS_ID=${PROCESS_ID}"
+  echo "NUM_PROCESSES=${NUM_PROCESSES}"
+  echo "COORDINATOR_ADDRESS=${COORDINATOR_ADDRESS}"
+  exit 0
+fi
+
+if (( $# == 0 )); then
+  set -- python -m nanosandbox_tpu.train
+fi
+
+echo "[entrypoint] host=${hostname_value} process_id=${PROCESS_ID}" \
+     "num_processes=${NUM_PROCESSES} coordinator=${COORDINATOR_ADDRESS:-<none>}"
+exec "$@"

@@ -35,7 +35,7 @@ class TrainConfig:
     eval_iters: int = 200
     eval_only: bool = False
     always_save_checkpoint: bool = True
-    init_from: str = "scratch"  # 'scratch' | 'resume'
+    init_from: str = "scratch"  # 'scratch' | 'resume' | 'auto' (resume if ckpt exists)
     keep_checkpoints: int = 3
 
     # -- model (reference ipynb:74-76: n_layer/n_head/n_embd/block_size/dropout) --

@@ -28,6 +28,15 @@ TINY_SHAKESPEARE_URL = (
 )
 
 
+def _warn_synthetic(what: str) -> None:
+    import sys
+
+    print(f"WARNING: {what} unavailable — using a SYNTHETIC corpus. "
+          "This is only valid for smoke tests; do not train real models "
+          "on it. Pass allow_synthetic=False to fail instead.",
+          file=sys.stderr)
+
+
 def _synthetic_corpus(n_chars: int = 200_000, seed: int = 1337) -> str:
     """Deterministic pseudo-text for offline smoke tests (Tier-0, SURVEY §4)."""
     rng = np.random.default_rng(seed)
@@ -65,6 +74,7 @@ def fetch_corpus(out_path: str, url: str = TINY_SHAKESPEARE_URL,
     except Exception:
         if not allow_synthetic:
             raise
+        _warn_synthetic(f"download of {url}")
         return _synthetic_corpus()
 
 
@@ -95,15 +105,37 @@ def prepare_char_dataset(out_dir: str, source_file: str | None = None,
     return write_bins(ids, out_dir, tok.meta())
 
 
+def download_openwebtext(num_chars: int, dataset_name: str = "Skylion007/openwebtext"
+                         ) -> str:
+    """Stream an OpenWebText subset via HF datasets (backlog #22's "small
+    OWT subset ... size via env"). Raises if the `datasets` package or the
+    network is unavailable — callers decide whether synthetic is acceptable.
+    """
+    import datasets  # noqa: PLC0415 — optional dep, only needed for OWT
+
+    stream = datasets.load_dataset(dataset_name, split="train", streaming=True)
+    chunks: list[str] = []
+    total = 0
+    for ex in stream:
+        doc = ex.get("text", "")
+        chunks.append(doc)
+        total += len(doc) + 1
+        if total >= num_chars:
+            break
+    return "\n".join(chunks)[:num_chars]
+
+
 def prepare_bpe_dataset(out_dir: str, source_files: list[str] | None = None,
                         text: str | None = None, tokenizer: str = "gpt2",
                         num_chars: int | None = None,
-                        allow_synthetic: bool = True) -> dict:
+                        allow_synthetic: bool = True,
+                        download: bool = True) -> dict:
     """OpenWebText-style prep (backlog item #22, gh_sync.ps1:144-148).
 
-    Reads source text files (or explicit text), tokenizes with GPT-2 BPE
-    (falling back to bytes offline), honors a size cap via ``num_chars``
-    (the backlog's "size via env").
+    Source resolution order: explicit ``text`` > ``source_files`` > streamed
+    OpenWebText download (capped at ``num_chars``) > synthetic (only when
+    ``allow_synthetic``, with a loud warning). Tokenizes with GPT-2 BPE,
+    falling back to bytes when tiktoken can't fetch its vocab offline.
     """
     if text is None:
         chunks = []
@@ -111,9 +143,16 @@ def prepare_bpe_dataset(out_dir: str, source_files: list[str] | None = None,
             with open(p, "r", encoding="utf-8") as f:
                 chunks.append(f.read())
         text = "\n".join(chunks)
+    if not text and download:
+        try:
+            text = download_openwebtext(num_chars or 10_000_000)
+        except Exception:
+            if not allow_synthetic:
+                raise
     if not text:
         if not allow_synthetic:
-            raise ValueError("no source text provided")
+            raise ValueError("no source text provided and download failed")
+        _warn_synthetic("openwebtext download")
         text = _synthetic_corpus(n_chars=num_chars or 1_000_000)
     if num_chars:
         text = text[:num_chars]
@@ -135,15 +174,26 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--num_chars", type=int,
                     default=int(os.environ.get("DATASET_NUM_CHARS", "0")) or None)
     ap.add_argument("--tokenizer", default="gpt2")
+    # shakespeare_char is the smoke-test dataset: synthetic fallback stays on
+    # by default (reference scale-down philosophy). openwebtext is a REAL
+    # training corpus: silent synthetic data would invalidate runs, so it
+    # fails loudly unless explicitly allowed (env for the k8s Job).
+    ap.add_argument("--allow_synthetic", default=None, action="store_true")
     args = ap.parse_args(argv)
+    allow_synth = args.allow_synthetic
+    if allow_synth is None:
+        env = os.environ.get("DATASET_ALLOW_SYNTHETIC", "")
+        allow_synth = (env == "1") if env else (args.dataset == "shakespeare_char")
 
     out_dir = os.path.join(args.data_dir, args.dataset)
     if args.dataset == "shakespeare_char":
-        stats = prepare_char_dataset(out_dir, source_file=args.source_file)
+        stats = prepare_char_dataset(out_dir, source_file=args.source_file,
+                                     allow_synthetic=allow_synth)
     else:
         stats = prepare_bpe_dataset(
             out_dir, source_files=[args.source_file] if args.source_file else None,
-            tokenizer=args.tokenizer, num_chars=args.num_chars)
+            tokenizer=args.tokenizer, num_chars=args.num_chars,
+            allow_synthetic=allow_synth)
     print(f"prepared {args.dataset} -> {out_dir}: {stats}")
 
 

@@ -311,7 +311,15 @@ class Trainer:
 
         iter_num = 0
         best_val_loss = 1e9
-        if cfg.init_from == "resume":
+        # 'auto' = resume when a checkpoint exists, else scratch — the mode
+        # k8s restarts use: a crashed pod comes back with the same identity
+        # (SURVEY.md §5 restart-with-stable-identity) and must continue, but
+        # the very first boot has nothing to restore.
+        init_from = cfg.init_from
+        if init_from == "auto":
+            init_from = ("resume" if ckpt.latest_step() is not None
+                         else "scratch")
+        if init_from == "resume":
             state, extra = ckpt.restore(self.abstract_state)
             iter_num = int(extra.get("iter_num", int(state["step"])))
             best_val_loss = float(extra.get("best_val_loss", 1e9))

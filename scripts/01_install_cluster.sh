@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Step 1 — provision a cluster that can schedule the training workloads.
+#
+# TPU-native successor of the reference's scripts/01_install_k3s_gpu_operator.sh
+# (described at /root/reference/README.md:28-32: k3s + NVIDIA GPU Operator so
+# pods can request nvidia.com/gpu). On GKE, TPU node pools ship their device
+# plugin — there is nothing to install; this script therefore has two modes:
+#
+#   MODE=gke   print/run the gcloud commands creating a TPU node-pool cluster
+#              (the google.com/tpu resource appears automatically)
+#   MODE=kind  create a local kind cluster for CPU-only validation of the
+#              manifests (the reference's scale-down testing philosophy,
+#              SURVEY.md §4 — every distributed feature has a no-hardware repro)
+#
+# Usage: MODE=kind bash scripts/01_install_cluster.sh
+set -euo pipefail
+
+MODE="${MODE:-kind}"
+CLUSTER_NAME="${CLUSTER_NAME:-disttrain}"
+
+case "$MODE" in
+  gke)
+    : "${GCP_PROJECT:?set GCP_PROJECT}"
+    : "${GCP_ZONE:?set GCP_ZONE (a TPU zone, e.g. us-central2-b)}"
+    TPU_TYPE="${TPU_TYPE:-tpu-v4-podslice}"
+    TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2x1}"
+    cat <<EOF
+# Run these (requires gcloud auth):
+gcloud container clusters create ${CLUSTER_NAME} \\
+  --project ${GCP_PROJECT} --zone ${GCP_ZONE} --num-nodes 1
+gcloud container node-pools create tpu-pool \\
+  --project ${GCP_PROJECT} --zone ${GCP_ZONE} --cluster ${CLUSTER_NAME} \\
+  --machine-type ct4p-hightpu-4t \\
+  --tpu-topology ${TPU_TOPOLOGY} --num-nodes 1
+# Validate the device plugin exposes the TPU resource:
+kubectl get nodes -o json | jq '.items[].status.allocatable["google.com/tpu"]'
+EOF
+    ;;
+  kind)
+    if ! command -v kind >/dev/null 2>&1; then
+      echo "kind not installed — install from https://kind.sigs.k8s.io" >&2
+      echo "(CPU-only manifest validation also works with any k8s cluster)" >&2
+      exit 1
+    fi
+    kind create cluster --name "${CLUSTER_NAME}" --wait 120s
+    kubectl cluster-info --context "kind-${CLUSTER_NAME}"
+    ;;
+  *)
+    echo "unknown MODE=${MODE} (expected gke|kind)" >&2
+    exit 2
+    ;;
+esac

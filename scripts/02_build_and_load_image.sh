@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Step 2 — build the training image and make it pullable by the cluster.
+#
+# Successor of the reference's scripts/02_build_and_load_image.sh
+# (/root/reference/README.md:34-38,103: docker build + `k3s ctr` import into
+# containerd so imagePullPolicy: IfNotPresent finds it). Three targets:
+#
+#   TARGET=kind  load into a local kind cluster (CI / manifest validation)
+#   TARGET=k3s   import into k3s containerd (the reference's mechanism)
+#   TARGET=push  push to a registry (GKE; set IMAGE to the registry path)
+#
+# Usage: TARGET=kind bash scripts/02_build_and_load_image.sh
+set -euo pipefail
+
+IMAGE="${IMAGE:-tpu-disttrain:latest}"
+TARGET="${TARGET:-kind}"
+CLUSTER_NAME="${CLUSTER_NAME:-disttrain}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+docker build -f "${REPO_ROOT}/docker/Dockerfile" -t "${IMAGE}" "${REPO_ROOT}"
+
+case "$TARGET" in
+  kind) kind load docker-image "${IMAGE}" --name "${CLUSTER_NAME}" ;;
+  k3s)  docker save "${IMAGE}" | sudo k3s ctr images import - ;;
+  push) docker push "${IMAGE}" ;;
+  *) echo "unknown TARGET=${TARGET} (expected kind|k3s|push)" >&2; exit 2 ;;
+esac
+
+echo "image ${IMAGE} ready for target ${TARGET}"

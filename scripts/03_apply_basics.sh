@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Step 3 — apply namespace + proxy ConfigMap + storage, in order.
+#
+# Successor of the reference's scripts/03_apply_basics.sh (named at
+# /root/reference/.github/ISSUE_TEMPLATE/bug_report.yml:23; bundles the
+# README.md:43-45 steps).
+# STORAGE=hostpath (default, single-node k3s/kind parity with the reference)
+# or STORAGE=filestore (GKE multi-node RWX — required for Workflow B when
+# pods land on different TPU hosts).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+K8S="${REPO_ROOT}/k8s"
+STORAGE="${STORAGE:-hostpath}"
+
+kubectl apply -f "${K8S}/00-namespace.yaml"
+kubectl apply -f "${K8S}/01-proxy-config.yaml"
+case "$STORAGE" in
+  hostpath)
+    kubectl apply -f "${K8S}/storage/10-pv.yaml"
+    kubectl apply -f "${K8S}/storage/11-pvc.yaml"
+    ;;
+  filestore)
+    kubectl apply -f "${K8S}/storage/12-filestore-rwx.yaml"
+    ;;
+  *) echo "unknown STORAGE=${STORAGE} (expected hostpath|filestore)" >&2; exit 2 ;;
+esac
+
+kubectl -n disttrain get pvc disttrain-pvc
+echo "basics applied: namespace, proxy-config, PV/PVC"

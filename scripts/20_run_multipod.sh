@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Launch Workflow B — multi-host training via headless Service + StatefulSet.
+#
+# Successor of the reference's scripts/20_run_multipod.sh (named at
+# /root/reference/.github/ISSUE_TEMPLATE/bug_report.yml:24; steps from
+# README.md:62-72: apply service, apply statefulset, wait for rollout,
+# follow pod-0 logs).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+K8S="${REPO_ROOT}/k8s"
+NS=disttrain
+
+kubectl apply -f "${K8S}/services/41-train-mp-headless.yaml"
+kubectl apply -f "${K8S}/statefulset/40-train-multipod.yaml"
+
+# All pods must come up for jax.distributed.initialize to complete —
+# rollout status is the liveness gate (reference README.md:67).
+kubectl -n "$NS" rollout status statefulset/train-multipod --timeout=10m
+
+echo "following logs of pod 0 (Ctrl-C detaches, training continues):"
+kubectl -n "$NS" logs -f train-multipod-0

@@ -91,3 +91,22 @@ def test_bpe_prepare_offline(tmp_path):
     assert stats["vocab_size"] == 256
     ds = BinDataset(str(tmp_path), "owt")
     assert ds.tokens("train") > 0
+
+
+def test_bpe_prepare_strict_raises_offline(tmp_path):
+    """A real-corpus prep must FAIL, not silently train on synthetic data,
+    when the download is unavailable and synthetic isn't allowed (the k8s
+    OWT Job's posture, k8s/jobs/21-download-openwebtext.yaml)."""
+    import pytest
+
+    with pytest.raises(Exception):
+        prepare_bpe_dataset(str(tmp_path / "owt2"), allow_synthetic=False,
+                            download=False)
+
+
+def test_bpe_prepare_synthetic_fallback_warns(tmp_path, capfd):
+    stats = prepare_bpe_dataset(str(tmp_path / "owt3"), tokenizer="byte",
+                                num_chars=5000, download=False,
+                                allow_synthetic=True)
+    assert stats["train_tokens"] > 0
+    assert "SYNTHETIC" in capfd.readouterr().err

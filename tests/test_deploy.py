@@ -1,0 +1,176 @@
+"""Deployment-shell tests: k8s manifests, entrypoint contract, scripts.
+
+The reference's backlogged CI item (gh_sync.ps1:154-158) asked for
+kubeval/yamllint + shellcheck; neither tool is in this image, so these tests
+implement the same checks natively: YAML well-formedness + schema
+invariants for every manifest, bash syntax checks for every script, and a
+behavioural test of the entrypoint's rank-derivation contract
+(README.md:21,102 — NODE_RANK from StatefulSet ordinal — reborn as
+PROCESS_ID for jax.distributed.initialize).
+"""
+
+import os
+import subprocess
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+K8S = os.path.join(REPO, "k8s")
+ENTRYPOINT = os.path.join(REPO, "container", "entrypoint.sh")
+
+MANIFESTS = [
+    "00-namespace.yaml",
+    "01-proxy-config.yaml",
+    "storage/10-pv.yaml",
+    "storage/11-pvc.yaml",
+    "storage/12-filestore-rwx.yaml",
+    "jobs/20-download-tiny-shakespeare.yaml",
+    "jobs/21-download-openwebtext.yaml",
+    "jobs/30-train-singlepod.yaml",
+    "services/41-train-mp-headless.yaml",
+    "statefulset/40-train-multipod.yaml",
+]
+
+
+def load(rel):
+    with open(os.path.join(K8S, rel)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+@pytest.mark.parametrize("rel", MANIFESTS)
+def test_manifest_parses(rel):
+    docs = load(rel)
+    assert docs, f"{rel} is empty"
+    for doc in docs:
+        assert {"apiVersion", "kind", "metadata"} <= set(doc), rel
+        # everything except cluster-scoped kinds is namespaced to disttrain
+        if doc["kind"] not in ("Namespace", "PersistentVolume",
+                               "StorageClass"):
+            assert doc["metadata"]["namespace"] == "disttrain", rel
+
+
+def test_filestore_pvc_swaps_in():
+    """12-filestore-rwx binds the SAME claim name with RWX, so the multipod
+    manifests work unchanged on multi-node GKE (hostPath is node-local)."""
+    docs = load("storage/12-filestore-rwx.yaml")
+    pvc = next(d for d in docs if d["kind"] == "PersistentVolumeClaim")
+    hostpath_pvc = load("storage/11-pvc.yaml")[0]
+    assert pvc["metadata"]["name"] == hostpath_pvc["metadata"]["name"]
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+
+
+def _pod_spec(doc):
+    return doc["spec"]["template"]["spec"]
+
+
+def test_jobs_mount_pvc_and_proxy():
+    for rel in ("jobs/20-download-tiny-shakespeare.yaml",
+                "jobs/21-download-openwebtext.yaml",
+                "jobs/30-train-singlepod.yaml"):
+        doc = load(rel)[0]
+        spec = _pod_spec(doc)
+        vols = {v["name"]: v for v in spec["volumes"]}
+        assert vols["data"]["persistentVolumeClaim"]["claimName"] == \
+            "disttrain-pvc", rel
+        c = spec["containers"][0]
+        assert {"name": "proxy-config"} in [
+            e["configMapRef"] for e in c["envFrom"]], rel
+        assert any(m["mountPath"] == "/data" for m in c["volumeMounts"]), rel
+
+
+def test_singlepod_requests_tpu():
+    """Workflow A requests google.com/tpu (was nvidia.com/gpu, README.md:118)."""
+    c = _pod_spec(load("jobs/30-train-singlepod.yaml")[0])["containers"][0]
+    assert "google.com/tpu" in c["resources"]["requests"]
+    assert "google.com/tpu" in c["resources"]["limits"]
+
+
+def test_statefulset_contract():
+    """Workflow B invariants that make the rendezvous work."""
+    sts = load("statefulset/40-train-multipod.yaml")[0]
+    svc = load("services/41-train-mp-headless.yaml")[0]
+    assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+    # headless + selector matches pod labels -> stable per-pod DNS
+    # (k8s spells headless as the literal string "None")
+    assert svc["spec"]["clusterIP"] in (None, "None")
+    labels = sts["spec"]["template"]["metadata"]["labels"]
+    assert svc["spec"]["selector"].items() <= labels.items()
+    # NUM_PROCESSES env must equal replicas (entrypoint contract)
+    c = _pod_spec(sts)["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert int(env["NUM_PROCESSES"]) == sts["spec"]["replicas"]
+    assert "google.com/tpu" in c["resources"]["requests"]
+    # all pods must start together or initialize() deadlocks
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+
+
+def _run_entrypoint(extra_env, *args):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PROCESS_ID", "NUM_PROCESSES", "COORDINATOR_ADDRESS",
+                        "HOSTNAME")}
+    env.update({"DRY_RUN": "1", **extra_env})
+    out = subprocess.run(["bash", ENTRYPOINT, *args], env=env,
+                         capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    return dict(line.split("=", 1) for line in out.stdout.strip().splitlines())
+
+
+def test_entrypoint_derives_ordinal():
+    got = _run_entrypoint({"HOSTNAME": "train-multipod-2", "NUM_PROCESSES": "3"})
+    assert got["PROCESS_ID"] == "2"
+    assert got["NUM_PROCESSES"] == "3"
+    assert got["COORDINATOR_ADDRESS"] == "train-multipod-0.train-mp-headless:12355"
+
+
+def test_entrypoint_single_process_default():
+    got = _run_entrypoint({"HOSTNAME": "train-singlepod-abc"})
+    # random pod-suffix digits must not fake an ordinal into multi-host mode
+    assert got["NUM_PROCESSES"] == "1"
+    assert got["COORDINATOR_ADDRESS"] == ""
+
+
+def test_entrypoint_no_ordinal_hostname():
+    got = _run_entrypoint({"HOSTNAME": "somehost", "NUM_PROCESSES": "1"})
+    assert got["PROCESS_ID"] == "0"
+
+
+def test_entrypoint_explicit_overrides_win():
+    got = _run_entrypoint({"HOSTNAME": "train-multipod-2", "NUM_PROCESSES": "4",
+                           "PROCESS_ID": "7",
+                           "COORDINATOR_ADDRESS": "elsewhere:1"})
+    assert got["PROCESS_ID"] == "7"
+    assert got["COORDINATOR_ADDRESS"] == "elsewhere:1"
+
+
+def test_entrypoint_custom_service_names():
+    got = _run_entrypoint({"HOSTNAME": "myjob-5", "NUM_PROCESSES": "8",
+                           "STATEFULSET_NAME": "myjob",
+                           "HEADLESS_SERVICE": "my-svc",
+                           "COORDINATOR_PORT": "999"})
+    assert got["PROCESS_ID"] == "5"
+    assert got["COORDINATOR_ADDRESS"] == "myjob-0.my-svc:999"
+
+
+@pytest.mark.parametrize("script", [
+    "container/entrypoint.sh",
+    "scripts/01_install_cluster.sh",
+    "scripts/02_build_and_load_image.sh",
+    "scripts/03_apply_basics.sh",
+    "scripts/20_run_multipod.sh",
+])
+def test_shell_syntax(script):
+    """bash -n: the shellcheck-lite the backlogged CI item asked for."""
+    path = os.path.join(REPO, script)
+    out = subprocess.run(["bash", "-n", path], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert os.access(path, os.X_OK), f"{script} not executable"
+
+
+def test_entrypoint_matches_distributed_module():
+    """The bash derivation and the python fallback must agree."""
+    from nanosandbox_tpu.parallel.distributed import (
+        derive_process_id_from_hostname)
+
+    assert derive_process_id_from_hostname("train-multipod-2") == 2
+    assert derive_process_id_from_hostname("somehost") is None

@@ -38,6 +38,20 @@ def test_run_end_to_end_and_resume(tiny_cfg):
     assert result2["iter_num"] == 15
 
 
+def test_init_from_auto(tiny_cfg, tmp_path):
+    """'auto' = scratch on first boot, resume after a crash/restart — the
+    mode the k8s StatefulSet passes (k8s/statefulset/40-train-multipod.yaml)
+    so restarted pods continue instead of silently starting over."""
+    cfg = tiny_cfg.replace(out_dir=str(tmp_path / "auto_out"), max_iters=6,
+                           eval_interval=3, eval_iters=1, init_from="auto")
+    result = Trainer(cfg).run()
+    assert result["iter_num"] == 6  # no checkpoint existed -> scratch
+
+    cfg2 = cfg.replace(max_iters=12)
+    result2 = Trainer(cfg2).run()
+    assert result2["iter_num"] == 12  # checkpoint existed -> resumed at 6
+
+
 def test_grad_accumulation_equivalence(tiny_cfg):
     """accum=2 with the same total tokens produces a finite, close loss."""
     cfg = tiny_cfg.replace(batch_size=8, gradient_accumulation_steps=2)
