@@ -158,6 +158,7 @@ def test_entrypoint_custom_service_names():
     "scripts/02_build_and_load_image.sh",
     "scripts/03_apply_basics.sh",
     "scripts/20_run_multipod.sh",
+    "scripts/gh_sync.sh",
 ])
 def test_shell_syntax(script):
     """bash -n: the shellcheck-lite the backlogged CI item asked for."""
