@@ -117,6 +117,17 @@ class Trainer:
 
         self.mesh = make_mesh(cfg.mesh_dp, cfg.mesh_fsdp, cfg.mesh_tp)
         self.batch_sharding = batch_sharding(self.mesh)
+        # Fail fast on batch/mesh mismatches instead of surfacing them later
+        # as opaque pjit sharding errors (docs/playbook.md pitfalls).
+        dp_shards = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        if cfg.batch_size % dp_shards:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must be divisible by "
+                f"data*fsdp mesh shards ({dp_shards})")
+        if cfg.sequences_per_iter % self.process_count:
+            raise ValueError(
+                f"batch_size*accum {cfg.sequences_per_iter} must be "
+                f"divisible by num_processes ({self.process_count})")
         self.tx, self.lr_schedule = make_optimizer(cfg)
 
         # Abstract state -> shardings -> sharded init.
