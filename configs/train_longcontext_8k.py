@@ -1,0 +1,35 @@
+# Long-context demonstration: GPT-2 124M at 8192-token context with ring
+# attention (sequence parallelism over the mesh's seq axis). Beyond the
+# reference's envelope (it caps at block_size=1024, SURVEY.md §5) — this is
+# the config that exercises ops/ring_attention.py at scale.
+#
+# Sized for a 4-chip host (mesh 1x1x4x1); no-hardware sanity run on 8
+# virtual devices needs --mesh_dp=2:
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#     python -m nanosandbox_tpu.train configs/train_longcontext_8k.py \
+#       --device=cpu --mesh_dp=2 --max_iters=2
+out_dir = "out/longcontext_8k"
+dataset = "openwebtext"
+vocab_size = 50304
+
+n_layer = 12
+n_head = 12
+n_embd = 768
+block_size = 8192
+dropout = 0.0
+
+mesh_dp = 1
+mesh_sp = 4          # sequence sharded 4-way; K/V rings over ICI
+attention_impl = "ring"
+remat = True         # 8k activations are HBM-hungry; trade FLOPs for memory
+
+batch_size = 4
+gradient_accumulation_steps = 8
+learning_rate = 6e-4
+max_iters = 600000
+lr_decay_iters = 600000
+warmup_iters = 2000
+eval_interval = 1000
+eval_iters = 100
+log_interval = 10
+compute_dtype = "bfloat16"

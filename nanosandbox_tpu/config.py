@@ -69,13 +69,15 @@ class TrainConfig:
     seed: int = 1337
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"  # MXU-native
-    attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
+    attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla' | 'ring'
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
 
-    # -- parallelism (mesh axes; SURVEY.md §2.5: DP required, FSDP stretch) --
+    # -- parallelism (mesh axes; SURVEY.md §2.5: DP required, FSDP stretch;
+    #    seq = ring-attention context parallelism beyond the reference) --
     mesh_dp: int = -1  # -1 = all remaining devices on the data axis
     mesh_fsdp: int = 1
     mesh_tp: int = 1
+    mesh_sp: int = 1  # sequence/context parallel (attention_impl='ring')
     shard_params: bool = False  # FSDP: shard params/opt-state over fsdp axis
 
     # -- distributed bootstrap (SURVEY.md §2.6; entrypoint derives these).

@@ -32,6 +32,7 @@ def _dense_init(std: float = 0.02):
 
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None  # required for attention_impl='ring' (sequence parallel)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
@@ -50,12 +51,26 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
 
-        attn_rng = None
-        if cfg.dropout > 0.0 and not deterministic:
-            attn_rng = self.make_rng("dropout")
-        y = causal_attention(q, k, v, impl=cfg.attention_impl,
-                             dropout_rate=0.0 if deterministic else cfg.dropout,
-                             dropout_rng=attn_rng)
+        if cfg.attention_impl == "ring":
+            # Sequence-parallel ring attention: T is sharded over the mesh's
+            # seq axis; K/V chunks rotate over ICI (ops/ring_attention.py).
+            from nanosandbox_tpu.ops.ring_attention import ring_attention_sharded
+            from nanosandbox_tpu.parallel.mesh import current_mesh
+
+            mesh = self.mesh if self.mesh is not None else current_mesh()
+            if mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' needs an active mesh — construct "
+                    "the model via Trainer, or call "
+                    "parallel.mesh.set_current_mesh(make_mesh(...)) first")
+            y = ring_attention_sharded(q, k, v, mesh=mesh)
+        else:
+            attn_rng = None
+            if cfg.dropout > 0.0 and not deterministic:
+                attn_rng = self.make_rng("dropout")
+            y = causal_attention(q, k, v, impl=cfg.attention_impl,
+                                 dropout_rate=0.0 if deterministic else cfg.dropout,
+                                 dropout_rng=attn_rng)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
 
         proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
@@ -90,13 +105,14 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
                                        param_dtype=cfg.param_dtype, name=name)
-        x = x + CausalSelfAttention(cfg, name="attn")(
+        x = x + CausalSelfAttention(cfg, mesh=self.mesh, name="attn")(
             ln("ln_1")(x).astype(cfg.compute_dtype), deterministic)
         x = x + MLP(cfg, name="mlp")(
             ln("ln_2")(x).astype(cfg.compute_dtype), deterministic)
@@ -105,6 +121,7 @@ class Block(nn.Module):
 
 class GPT(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None  # bound by Trainer; needed for attention_impl='ring'
 
     @nn.compact
     def __call__(self, idx: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -130,7 +147,7 @@ class GPT(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+            x = block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic)
 
         x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)

@@ -1,0 +1,133 @@
+"""Ring attention: causal attention over a sequence-parallel mesh axis.
+
+Long-context support beyond the reference's capability envelope (the
+reference caps at block_size=1024 and has no sequence parallelism,
+SURVEY.md §5 "Long-context"): the sequence dimension is sharded over the
+mesh's ``seq`` axis, each device holds a T/cp chunk of Q/K/V, and K/V
+chunks rotate around the ring via ``lax.ppermute`` while an online-softmax
+accumulator builds the exact attention output — full attention over the
+global sequence without ever materializing global K/V (or the (T, T)
+score matrix) on any chip.
+
+TPU-first shape: the per-step block matmuls are MXU-sized, the rotation is
+a neighbor exchange that XLA schedules on ICI and overlaps with the block
+compute, and the whole loop is unrolled at trace time (cp is a static mesh
+property) so autodiff works straight through — the backward pass rotates
+in the opposite direction automatically via the transpose of ppermute.
+
+Composition: designed to run inside jit via jax.shard_map; everything
+outside attention (MLP, layernorm, embeddings) is position-wise, so the
+GSPMD partitioner handles the sharded T dimension there with no
+collectives at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, axis_size: int, causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Per-shard ring attention body (call under shard_map).
+
+    q, k, v: (B, H, Tc, D) local sequence chunks; global T = Tc * axis_size,
+    chunked contiguously (device i holds positions [i*Tc, (i+1)*Tc)).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, Tc, D = q.shape
+    my = lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32) * sm_scale
+    q_pos = my * Tc + lax.broadcasted_iota(jnp.int32, (Tc, Tc), 0)
+
+    acc = jnp.zeros((B, H, Tc, D), jnp.float32)
+    m = jnp.full((B, H, Tc, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Tc, 1), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def block_update(carry, k, v, src):
+        acc, m, l = carry
+        k_pos = src * Tc + lax.broadcasted_iota(jnp.int32, (Tc, Tc), 1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k.astype(jnp.float32))
+        if causal:
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                       v.astype(jnp.float32))
+        return acc, m_new, l
+
+    carry = (acc, m, l)
+    for s in range(axis_size):
+        # After s rotations device `my` holds the chunk originating at
+        # ring position (my - s) mod cp.
+        src = (my - s) % axis_size
+        if causal and s > 0:
+            # Chunks strictly in this query's future are fully masked:
+            # skip their matmuls entirely (they'd contribute exactly 0).
+            # With contiguous chunking that's blocks where src > my, i.e.
+            # s > my — devices still step the ring together, but a skipping
+            # device does no attention FLOPs this step. (A zigzag chunk
+            # layout that equalizes per-device work is the follow-on
+            # optimization; contiguous-but-skipping is exact already.)
+            carry = lax.cond(s <= my,
+                             lambda c, kk, vv: block_update(c, kk, vv, src),
+                             lambda c, kk, vv: c,
+                             carry, k, v)
+        else:
+            carry = block_update(carry, k, v, src)
+        if s != axis_size - 1:  # last chunk needs no forwarding
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    acc, m, l = carry
+
+    # Fully-masked rows (none exist for causal self-attention, but guard
+    # the division for robustness) normalize to zero.
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_fn(mesh, causal: bool, sm_scale: float, seq_axis: str):
+    spec = P(("data", "fsdp"), "model", seq_axis, None)
+    body = functools.partial(
+        ring_attention, axis_name=seq_axis,
+        axis_size=mesh.shape[seq_axis], causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                           mesh, causal: bool = True,
+                           sm_scale: Optional[float] = None,
+                           seq_axis: str = "seq") -> jax.Array:
+    """Ring attention over (B, H, T, D) global arrays on ``mesh``.
+
+    Batch is sharded over (data, fsdp), heads over model, sequence over
+    ``seq_axis``. With a size-1 seq axis this degenerates to one local
+    flash/XLA-equivalent block — still correct, so callers don't need a
+    special case.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    T = q.shape[2]
+    cp = mesh.shape[seq_axis]
+    if T % cp:
+        raise ValueError(f"sequence length {T} not divisible by seq axis {cp}")
+    return _sharded_fn(mesh, causal, float(sm_scale), seq_axis)(q, k, v)

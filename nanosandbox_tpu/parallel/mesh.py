@@ -1,15 +1,18 @@
 """Device mesh construction and batch sharding.
 
 Axes: ``data`` (pure data parallel), ``fsdp`` (data parallel + parameter
-sharding — ZeRO-3 style), ``model`` (tensor parallel, open for scale-up).
-The batch is sharded over (data, fsdp) jointly; params are replicated over
-``data``, sharded over ``fsdp`` when cfg.shard_params, and sharded over
-``model`` per the TP rules in sharding.py.
+sharding — ZeRO-3 style), ``seq`` (sequence/context parallel — ring
+attention over long sequences, ops/ring_attention.py), ``model`` (tensor
+parallel). The batch dim is sharded over (data, fsdp) jointly and the
+sequence dim over ``seq``; params are replicated over ``data``/``seq``,
+sharded over ``fsdp`` when cfg.shard_params, and sharded over ``model``
+per the TP rules in sharding.py.
 
 Replaces the reference's torchrun process-group topology (SURVEY.md §2.5):
 workflow A (1 pod × 3 GPU) maps to a single-host mesh over local devices;
 workflow B (3 pods × 1 GPU) maps to the same mesh spanning hosts after
-jax.distributed.initialize.
+jax.distributed.initialize. The ``seq`` and ``model`` axes go beyond the
+reference's DDP-only envelope.
 """
 
 from __future__ import annotations
@@ -18,37 +21,54 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "model")
+AXES = ("data", "fsdp", "seq", "model")
+
+_CURRENT_MESH: Mesh | None = None
 
 
 def make_mesh(mesh_dp: int = -1, mesh_fsdp: int = 1, mesh_tp: int = 1,
-              devices: list | None = None) -> Mesh:
-    """Build a (data, fsdp, model) mesh over all devices.
+              mesh_sp: int = 1, devices: list | None = None) -> Mesh:
+    """Build a (data, fsdp, seq, model) mesh over all devices.
 
-    mesh_dp = -1 means "all devices not claimed by fsdp/model". Axis order
-    puts ``model`` innermost so TP collectives ride the fastest ICI links,
-    then ``fsdp``, then ``data`` outermost (its allreduce tolerates DCN).
+    mesh_dp = -1 means "all devices not claimed by fsdp/seq/model". Axis
+    order puts ``model`` innermost so TP collectives ride the fastest ICI
+    links, then ``seq`` (ring neighbor exchanges), then ``fsdp``, then
+    ``data`` outermost (its allreduce tolerates DCN).
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    if mesh_fsdp <= 0 or mesh_tp <= 0:
-        raise ValueError("mesh_fsdp and mesh_tp must be positive")
+    if mesh_fsdp <= 0 or mesh_tp <= 0 or mesh_sp <= 0:
+        raise ValueError("mesh_fsdp, mesh_tp, and mesh_sp must be positive")
+    claimed = mesh_fsdp * mesh_tp * mesh_sp
     if mesh_dp == -1:
-        if n % (mesh_fsdp * mesh_tp):
+        if n % claimed:
             raise ValueError(
-                f"{n} devices not divisible by fsdp*tp={mesh_fsdp * mesh_tp}")
-        mesh_dp = n // (mesh_fsdp * mesh_tp)
-    if mesh_dp * mesh_fsdp * mesh_tp != n:
+                f"{n} devices not divisible by fsdp*sp*tp={claimed}")
+        mesh_dp = n // claimed
+    if mesh_dp * claimed != n:
         raise ValueError(
-            f"mesh {mesh_dp}x{mesh_fsdp}x{mesh_tp} != {n} devices")
-    dev_array = np.asarray(devices).reshape(mesh_dp, mesh_fsdp, mesh_tp)
+            f"mesh {mesh_dp}x{mesh_fsdp}x{mesh_sp}x{mesh_tp} != {n} devices")
+    dev_array = np.asarray(devices).reshape(mesh_dp, mesh_fsdp, mesh_sp,
+                                            mesh_tp)
     return Mesh(dev_array, AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch dim sharded over data+fsdp jointly; sequence dim replicated."""
-    return NamedSharding(mesh, P(("data", "fsdp"), None))
+    """Batch dim over data+fsdp jointly; sequence dim over seq."""
+    return NamedSharding(mesh, P(("data", "fsdp"), "seq"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def set_current_mesh(mesh: Mesh | None) -> None:
+    """Record the active training mesh so mesh-aware ops (ring attention)
+    can be reached from inside model code without threading the mesh
+    through every module signature."""
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Mesh | None:
+    return _CURRENT_MESH

@@ -88,6 +88,15 @@ def main(argv: list[str] | None = None) -> list[str]:
                          "device": "auto", "init_from": "resume",
                          "out_dir": args.out_dir,
                          "data_dir": args.data_dir})
+    if (cfg.attention_impl == "ring" or cfg.mesh_sp > 1
+            or cfg.mesh_fsdp > 1 or cfg.mesh_tp > 1):
+        # Decode is short-sequence and runs on whatever host invokes it:
+        # drop all training-time model/sequence parallelism — Orbax restores
+        # checkpoints onto any mesh, and a pure-DP mesh always fits.
+        cfg = cfg.replace(attention_impl="auto" if cfg.attention_impl == "ring"
+                          else cfg.attention_impl,
+                          mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1,
+                          shard_params=False)
     trainer = Trainer(cfg)
     state, _ = ckpt.restore(trainer.abstract_state, step)
     params = state["params"]

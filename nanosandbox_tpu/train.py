@@ -100,7 +100,8 @@ class Trainer:
         from nanosandbox_tpu.models.gpt import GPT
         from nanosandbox_tpu.parallel.distributed import (
             maybe_initialize_distributed)
-        from nanosandbox_tpu.parallel.mesh import batch_sharding, make_mesh
+        from nanosandbox_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                                   set_current_mesh)
         from nanosandbox_tpu.parallel.sharding import param_shardings
 
         self.cfg = cfg
@@ -113,9 +114,13 @@ class Trainer:
         self.dataset = BinDataset(cfg.data_dir, cfg.dataset)
         vocab = cfg.vocab_size or self.dataset.vocab_size
         self.model_cfg = GPTConfig.from_train_config(cfg, vocab)
-        self.model = GPT(self.model_cfg)
 
-        self.mesh = make_mesh(cfg.mesh_dp, cfg.mesh_fsdp, cfg.mesh_tp)
+        self.mesh = make_mesh(cfg.mesh_dp, cfg.mesh_fsdp, cfg.mesh_tp,
+                              cfg.mesh_sp)
+        set_current_mesh(self.mesh)
+        # The mesh is bound to the model explicitly (ring attention needs
+        # it); the global above is only a fallback for standalone model use.
+        self.model = GPT(self.model_cfg, mesh=self.mesh)
         self.batch_sharding = batch_sharding(self.mesh)
         # Fail fast on batch/mesh mismatches instead of surfacing them later
         # as opaque pjit sharding errors (docs/playbook.md pitfalls).
@@ -128,6 +133,23 @@ class Trainer:
             raise ValueError(
                 f"batch_size*accum {cfg.sequences_per_iter} must be "
                 f"divisible by num_processes ({self.process_count})")
+        if cfg.block_size % self.mesh.shape["seq"]:
+            raise ValueError(
+                f"block_size {cfg.block_size} must be divisible by the "
+                f"seq mesh axis ({self.mesh.shape['seq']})")
+        if cfg.mesh_sp > 1 and cfg.attention_impl != "ring":
+            raise ValueError(
+                "mesh_sp > 1 requires attention_impl='ring' (other impls "
+                "compute attention over the local sequence shard only)")
+        if cfg.attention_impl == "ring" and cfg.dropout > 0:
+            raise ValueError(
+                "attention_impl='ring' does not support attention-prob "
+                "dropout; set dropout=0 or use attention_impl='xla'")
+        if (cfg.attention_impl == "ring" and cfg.mesh_tp > 1
+                and cfg.n_head % cfg.mesh_tp):
+            raise ValueError(
+                f"attention_impl='ring' shards heads over model: n_head "
+                f"{cfg.n_head} must be divisible by mesh_tp {cfg.mesh_tp}")
         self.tx, self.lr_schedule = make_optimizer(cfg)
 
         # Abstract state -> shardings -> sharded init.
@@ -155,7 +177,15 @@ class Trainer:
     def _init_state(self, rng) -> dict[str, Any]:
         import jax.numpy as jnp
 
-        dummy = jnp.zeros((2, min(8, self.cfg.block_size)), jnp.int32)
+        # The dummy init batch must satisfy the same sharding divisibility
+        # as real batches (ring attention's shard_map validates shapes at
+        # trace time): B divisible by data*fsdp, T by the seq axis.
+        dp_shards = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        sp = self.mesh.shape["seq"]
+        B = max(2, dp_shards)
+        T = min(self.cfg.block_size, max(8, sp))
+        T = max(sp, (T // sp) * sp)
+        dummy = jnp.zeros((B, T), jnp.int32)
         variables = self.model.init(rng, dummy, deterministic=True)
         params = variables["params"]
         opt_state = self.tx.init(params)

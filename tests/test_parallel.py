@@ -19,11 +19,13 @@ def test_eight_virtual_devices():
 
 def test_make_mesh_shapes():
     m = make_mesh()
-    assert m.devices.shape == (8, 1, 1)
+    assert m.devices.shape == (8, 1, 1, 1)
     m = make_mesh(mesh_fsdp=4)
-    assert m.devices.shape == (2, 4, 1)
+    assert m.devices.shape == (2, 4, 1, 1)
     m = make_mesh(mesh_dp=2, mesh_fsdp=2, mesh_tp=2)
-    assert m.devices.shape == (2, 2, 2)
+    assert m.devices.shape == (2, 2, 1, 2)
+    m = make_mesh(mesh_sp=4)
+    assert m.devices.shape == (2, 1, 4, 1)
     with pytest.raises(ValueError):
         make_mesh(mesh_dp=3)
 
@@ -37,7 +39,7 @@ def test_batch_is_sharded_over_data():
 
 
 def test_spec_rules():
-    sizes = {"data": 2, "fsdp": 2, "model": 2}
+    sizes = {"data": 2, "fsdp": 2, "seq": 1, "model": 2}
     s = spec_for_param("h_0/attn/c_attn/kernel", (64, 192),
                        axis_sizes=sizes, shard_params=True, tp=True)
     assert s == P("fsdp", "model")

@@ -1,0 +1,141 @@
+"""Ring attention (sequence/context parallelism) on 8 virtual CPU devices.
+
+Correctness bar: ring attention over a sharded sequence must match plain
+XLA attention over the full sequence — forward AND gradients — because it
+computes the exact same math, just blockwise around the ring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.ops.attention import xla_attention
+from nanosandbox_tpu.ops.ring_attention import ring_attention_sharded
+from nanosandbox_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                           set_current_mesh)
+
+
+def _qkv(B=2, H=4, T=64, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_xla_forward(sp):
+    mesh = make_mesh(mesh_dp=1, mesh_sp=sp, devices=jax.devices()[:sp])
+    q, k, v = _qkv()
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_matches_xla_gradients():
+    mesh = make_mesh(mesh_dp=2, mesh_sp=4)  # B=2 over dp=2, T over sp=4
+    q, k, v = _qkv()
+
+    def loss_ring(q, k, v):
+        return (ring_attention_sharded(q, k, v, mesh=mesh) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_ring_seq_axis_one_degenerates():
+    mesh = make_mesh(mesh_dp=1, devices=jax.devices()[:1])  # seq axis size 1
+    q, k, v = _qkv(T=32)
+    ref = xla_attention(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = make_mesh(mesh_dp=2, mesh_sp=4)
+    q, k, v = _qkv(T=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention_sharded(q, k, v, mesh=mesh)
+
+
+def test_ring_end_to_end_training(tiny_cfg):
+    """Tiny GPT trains under mesh_sp=4 with ring attention; loss falls and
+    the first-step loss matches the non-sequence-parallel run (same data)."""
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = tiny_cfg.replace(batch_size=8, mesh_dp=2, mesh_sp=4,
+                           attention_impl="ring")
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=False)
+    losses = []
+    rng = jax.random.key(0)
+    for _ in range(8):
+        xb, yb = next(loader)
+        state, m = train_step(state, trainer.to_global(xb),
+                              trainer.to_global(yb), rng)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    # parity with a plain-DP run on identical data
+    cfg2 = tiny_cfg.replace(batch_size=8, mesh_dp=8)
+    t2 = Trainer(cfg2)
+    s2 = t2.init_state()
+    step2, _ = t2.compiled_steps()
+    loader2 = t2.make_loader("train", prefetch=False)
+    xb, yb = next(loader2)
+    _, m2 = step2(s2, t2.to_global(xb), t2.to_global(yb), jax.random.key(0))
+    assert float(m2["loss"]) == pytest.approx(losses[0], rel=1e-4)
+
+
+def test_ring_trainer_with_dp_and_coexisting_trainer(tiny_cfg):
+    """Regressions: (a) Trainer init must work for ring configs whose
+    data*fsdp shards exceed the old fixed dummy batch of 2; (b) a second
+    Trainer must not silently steal the ring Trainer's mesh (the model
+    binds its mesh explicitly)."""
+    import jax
+
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = tiny_cfg.replace(batch_size=8, mesh_dp=4, mesh_sp=2,
+                           attention_impl="ring")
+    trainer = Trainer(cfg)
+    state = trainer.init_state()  # dummy init batch respects the shardings
+
+    # Constructing another trainer overwrites the *global* mesh...
+    other = Trainer(tiny_cfg.replace(batch_size=8, mesh_dp=8))
+    assert other.mesh is not trainer.mesh
+
+    # ...but the ring trainer still traces with ITS OWN mesh afterwards.
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=False)
+    xb, yb = next(loader)
+    _, m = train_step(state, trainer.to_global(xb), trainer.to_global(yb),
+                      jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_trainer_validates_ring_config(tiny_cfg):
+    from nanosandbox_tpu.train import Trainer
+
+    with pytest.raises(ValueError, match="requires attention_impl='ring'"):
+        Trainer(tiny_cfg.replace(mesh_dp=4, mesh_sp=2))
+    with pytest.raises(ValueError, match="block_size"):
+        Trainer(tiny_cfg.replace(mesh_dp=1, mesh_sp=8, block_size=60,
+                                 attention_impl="ring"))
+    with pytest.raises(ValueError, match="dropout"):
+        Trainer(tiny_cfg.replace(mesh_dp=4, mesh_sp=2, dropout=0.1,
+                                 attention_impl="ring"))
+
+
+def teardown_module():
+    set_current_mesh(None)
