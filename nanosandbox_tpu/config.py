@@ -71,6 +71,10 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # MXU-native
     attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla' | 'ring'
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    # Fused LM-head + cross-entropy, scanned over sequence chunks of this
+    # many positions so full (B, T, vocab) logits never hit HBM. 0 disables
+    # (plain full-logits loss). Auto-disabled under sequence parallelism.
+    loss_chunk_size: int = 128
 
     # -- parallelism (mesh axes; SURVEY.md §2.5: DP required, FSDP stretch;
     #    seq = ring-attention context parallelism beyond the reference) --

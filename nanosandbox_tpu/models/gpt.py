@@ -124,7 +124,12 @@ class GPT(nn.Module):
     mesh: Any = None  # bound by Trainer; needed for attention_impl='ring'
 
     @nn.compact
-    def __call__(self, idx: jax.Array, *, deterministic: bool = True) -> jax.Array:
+    def __call__(self, idx: jax.Array, *, deterministic: bool = True,
+                 return_hidden: bool = False) -> jax.Array:
+        """Returns logits (B, T, vocab) — or, with return_hidden=True, the
+        final-layernorm hidden states (B, T, C) so the caller can fuse the
+        LM head into a chunked loss (chunked_cross_entropy_loss) without
+        ever materializing full logits in HBM."""
         cfg = self.cfg
         B, T = idx.shape
         if T > cfg.block_size:
@@ -151,6 +156,8 @@ class GPT(nn.Module):
 
         x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         # Weight-tied LM head (nanoGPT ties lm_head.weight = wte.weight).
         logits = wte.attend(x.astype(cfg.param_dtype))
         return logits
@@ -166,6 +173,56 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
     nll = jnp.where(valid, nll, 0.0)
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def chunked_cross_entropy_loss(hidden: jax.Array, embedding: jax.Array,
+                               targets: jax.Array, *, chunk_size: int = 128,
+                               compute_dtype: str = "bfloat16",
+                               ignore_index: int = -1) -> jax.Array:
+    """Fused LM-head + cross entropy, scanned over sequence chunks.
+
+    The full-logits path materializes a (B, T, vocab) float32 tensor —
+    13 GB at batch 64 / 1024 ctx / 50304 vocab, the single largest HBM
+    consumer of the whole train step and the reason batch size caps early.
+    Here the weight-tied head matmul runs chunk-by-chunk inside a
+    lax.scan whose body is jax.checkpoint'd: only (B, chunk, vocab) logits
+    are ever alive, forward or backward (the backward recomputes the chunk
+    matmul instead of saving it). The matmul feeds the MXU in
+    ``compute_dtype`` with float32 accumulation, softmax math is float32.
+
+    hidden: (B, T, C) from GPT(..., return_hidden=True); embedding: (V, C)
+    (the tied wte table). Matches cross_entropy_loss numerics.
+    """
+    from jax import lax
+
+    B, T, C = hidden.shape
+    cs = min(chunk_size, T)
+    while T % cs:
+        cs -= 1  # largest divisor <= chunk_size; worst case 1
+    n = T // cs
+    dtype = jnp.dtype(compute_dtype)
+    h = hidden.reshape(B, n, cs, C).transpose(1, 0, 2, 3)
+    y = targets.reshape(B, n, cs).transpose(1, 0, 2)
+    emb = embedding.astype(dtype)
+
+    @jax.checkpoint
+    def body(carry, xy):
+        h_c, y_c = xy
+        logits = lax.dot_general(
+            h_c.astype(dtype), emb,
+            (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (B, cs, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = y_c != ignore_index
+        safe = jnp.where(valid, y_c, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        tot, cnt = carry
+        return (tot + jnp.where(valid, nll, 0.0).sum(),
+                cnt + valid.sum()), None
+
+    (tot, cnt), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (h, y))
+    return tot / jnp.maximum(cnt, 1)
 
 
 def count_params(params: Any, include_embeddings: bool = True) -> int:

@@ -1,0 +1,45 @@
+import os, time, tempfile, sys
+import numpy as np
+import jax
+
+from nanosandbox_tpu.config import TrainConfig
+from nanosandbox_tpu.train import Trainer
+from nanosandbox_tpu.data.prepare import prepare_char_dataset
+
+impl = sys.argv[1] if len(sys.argv) > 1 else "pallas"
+bs = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+remat = len(sys.argv) > 3 and sys.argv[3] == "remat"
+
+tmp = tempfile.mkdtemp()
+data = os.path.join(tmp, "data")
+prepare_char_dataset(os.path.join(data, "shakespeare_char"),
+                     allow_synthetic=True, url="http://x.localhost/no")
+
+cfg = TrainConfig(out_dir=os.path.join(tmp, "o"), data_dir=data,
+                  dataset="shakespeare_char", vocab_size=50304,
+                  n_layer=12, n_head=12, n_embd=768, block_size=1024,
+                  batch_size=bs, max_iters=0, eval_interval=0,
+                  dropout=0.0, compute_dtype="bfloat16",
+                  attention_impl=impl, remat=remat, tensorboard=False)
+t = Trainer(cfg)
+state = t.init_state()
+step, _ = t.compiled_steps()
+xb, yb = t.dataset.sample_batch("train", 0, cfg.sequences_per_iter,
+                                cfg.block_size, seed=0)
+xg, yg = t.to_global(xb), t.to_global(yb)
+rng = jax.random.key(0)
+
+for _ in range(3):
+    state, m = step(state, xg, yg, rng)
+print("warm loss", float(m["loss"]))
+
+N = 20
+t0 = time.perf_counter()
+for _ in range(N):
+    state, m = step(state, xg, yg, rng)
+_ = float(m["loss"])  # single sync at end
+dt = (time.perf_counter() - t0) / N
+toks = cfg.tokens_per_iter / dt
+mfu = t.flops_per_iter() / dt / t.peak_flops()
+print(f"impl={impl} bs={bs} remat={remat}: {dt*1000:.1f} ms/step, "
+      f"{toks:,.0f} tok/s, mfu {mfu*100:.1f}%")
