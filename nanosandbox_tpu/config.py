@@ -95,10 +95,32 @@ class TrainConfig:
     tensorboard: bool = True
     run_name: str = ""
     log_dir: str = ""  # default: <out_dir>/runs (README.md:86 /data/runs)
+    # 'a:b' — capture a jax.profiler device trace of iters [a, b) to
+    # <log_dir>/profile (view with tensorboard or xprof; main process only)
+    profile_steps: str = ""
 
     def __post_init__(self) -> None:
         if self.lr_decay_iters <= 0:
             self.lr_decay_iters = self.max_iters
+        if self.profile_steps:  # fail fast, before any resources exist
+            self.profile_range()
+
+    def profile_range(self) -> tuple[int, int] | None:
+        """Parsed --profile_steps=a:b, validated. None when unset."""
+        if not self.profile_steps:
+            return None
+        parts = self.profile_steps.split(":")
+        try:
+            a, b = (int(p) for p in parts)
+        except ValueError:
+            raise ValueError(
+                f"profile_steps expects 'a:b' integers, got "
+                f"{self.profile_steps!r}") from None
+        if len(parts) != 2 or a < 0 or b <= a:
+            raise ValueError(
+                f"profile_steps expects 'a:b' with 0 <= a < b, got "
+                f"{self.profile_steps!r}")
+        return a, b
 
     @property
     def resolved_log_dir(self) -> str:

@@ -1,4 +1,4 @@
-"""Causal self-attention: Pallas flash-attention forward for TPU + XLA fallback.
+"""Causal self-attention: Pallas flash-attention (fwd + bwd) for TPU + XLA fallback.
 
 The reference's training core (karpathy/nanoGPT, exercised via
 /root/reference/notebooks/colab_nanoGPT_companion.ipynb:71-78) relies on
@@ -7,18 +7,29 @@ equivalent is a Pallas kernel compiled by Mosaic: the forward pass is an
 online-softmax (flash) kernel that never materializes the (T, T) score
 matrix in HBM, tiled to the MXU (128-lane blocks, f32 accumulation).
 
-The backward pass recomputes attention with the XLA implementation under
-jax.custom_vjp — at the reference's context lengths (block_size <= 1024,
-ipynb:74) the recompute is cheap and XLA fuses it well; a dedicated Pallas
-backward is a later optimization.
+The backward pass is two Pallas kernels under jax.custom_vjp sharing the
+forward's per-row logsumexp L and the precomputed row term
+Drow = rowsum(dO * O): one computes dQ (parallel over query blocks), the
+other dK/dV (parallel over key blocks); both recompute P = exp(S - L)
+block-by-block instead of saving the (T, T) probability matrix, and both
+skip fully-masked blocks at the causal frontier.
+
+Mosaic layout note: per-row softmax stats (L, Drow) are stored
+lane-REPLICATED as (..., T, 128) arrays — Mosaic requires the last two
+block dims of every operand to tile onto (8, 128) sublane×lane registers,
+so a (1, block_q) row-vector block cannot lower; broadcasting each row
+stat across the 128-lane minor dim (the same layout jax's own
+pallas.ops.tpu.flash_attention uses) makes every BlockSpec legal at the
+cost of a 128x blowup on two tiny T-length vectors.
 
 Layouts: q, k, v are (B, H, T, D). D (head_dim) is padded to a multiple of
-128 lanes inside the Pallas path when needed.
+128 lanes and T to a multiple of the 128-row block inside the Pallas path.
 """
 
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -26,8 +37,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LANES = 128  # minor-dim register width; row stats are replicated across it
 
-__all__ = ["causal_attention", "xla_attention", "flash_attention"]
+__all__ = ["causal_attention", "xla_attention", "flash_attention",
+           "pallas_compile_probe"]
 
 
 # ---------------------------------------------------------------------------
@@ -111,13 +124,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     acc, m, l = lax.fori_loop(0, num_kb, body, init)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # Per-row logsumexp, the softmax residual the flash backward needs
-    # (recomputing p = exp(s - L) block-by-block instead of saving (T, T)).
-    lse_ref[...] = (m + jnp.log(l)).reshape(1, block_q)
+    # (recomputing p = exp(s - L) block-by-block instead of saving (T, T)),
+    # written lane-replicated: (block_q, 1) broadcast across the 128-lane
+    # minor dim so the output block tiles legally onto Mosaic registers.
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
 
 
 def _pad_qkv(q, k, v, block_q, block_k, causal):
     """Pad head_dim to the 128-lane tile and T to the block size; returns
     padded (B*H, Tp, Dp)-flattened tensors plus the pad bookkeeping."""
+    if block_q % 8 or block_k % LANES:
+        raise ValueError(
+            f"block_q must be a multiple of 8 and block_k of {LANES} "
+            f"(got {block_q}, {block_k}): Mosaic tiles blocks onto "
+            f"(8, 128) sublane*lane registers")
     B, H, T, D = q.shape
     pad_D = (-D) % 128
     if pad_D:
@@ -141,10 +161,8 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       causal: bool, sm_scale: float,
                       block_q: int = 128, block_k: int = 128,
                       interpret: bool = False):
-    """Returns (out, lse) — lse is the per-row logsumexp (B, H, T)."""
-    B, H, T, D = q.shape
-    block_q = min(block_q, max(T, 8))
-    block_k = min(block_k, max(T, 8))
+    """Returns (out, lse) — lse is the lane-replicated per-row logsumexp
+    with PADDED shape (B*H, Tp, 128); the bwd kernels consume it as-is."""
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
 
@@ -162,16 +180,15 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tp, Dp), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tp, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(B, H, Tp, Dp)[:, :, :T, :D]
-    lse = lse.reshape(B, H, Tp)[:, :, :T]
     return out, lse
 
 
@@ -195,8 +212,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
     qi = pl.program_id(1)
     q = q_ref[0]                                     # (bq, D) storage dtype
     do = do_ref[0]
-    lse = lse_ref[...].reshape(block_q, 1)           # (bq, 1) f32
-    drow = drow_ref[...].reshape(block_q, 1)
+    # Row stats arrive lane-replicated (bq, 128); tiling to (bq, bk) gives
+    # the broadcast the math needs without any Mosaic-illegal row vectors.
+    rep = block_k // LANES
+    lse = jnp.tile(lse_ref[0], (1, rep))             # (bq, bk) f32
+    drow = jnp.tile(drow_ref[0], (1, rep))
     seq_len = k_ref.shape[1]
     num_kb = (lax.div((qi + 1) * block_q + block_k - 1, block_k)
               if causal else seq_len // block_k)
@@ -237,12 +257,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
 
+    rep = block_k // LANES
+
     def body(i, carry):
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)].reshape(block_q, 1)
-        drow = drow_ref[0, pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+        lse = jnp.tile(
+            lse_ref[0, pl.ds(i * block_q, block_q), :], (1, rep))
+        drow = jnp.tile(
+            drow_ref[0, pl.ds(i * block_q, block_q), :], (1, rep))
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -273,19 +297,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
 def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
                       block_q: int = 128, block_k: int = 128,
                       interpret: bool = False):
-    B, H, T, D = q.shape
-    block_q = min(block_q, max(T, 8))
-    block_k = min(block_k, max(T, 8))
+    """lse arrives compact and T-padded from the forward: (B*H, Tp, 1)
+    f32; both row stats are lane-replicated transiently here."""
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
     dof = _pad_qkv(do, do, do, block_q, block_k, causal)[0]
     # Row terms; padded rows get zeros (their do rows are zero anyway).
     drow = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
     if pad_T:
-        lse = jnp.pad(lse, [(0, 0), (0, 0), (0, pad_T)])
         drow = jnp.pad(drow, [(0, 0), (0, 0), (0, pad_T)])
-    lsef = lse.reshape(B * H, Tp)
-    drowf = drow.reshape(B * H, Tp)
+    # Lane-replicate to the layout the kernels consume.
+    drowf = jnp.broadcast_to(drow.reshape(B * H, Tp, 1), (B * H, Tp, LANES))
+    lsef = jnp.broadcast_to(lse, (B * H, Tp, LANES))
 
     grid_q = (B * H, Tp // block_q)
     dq = pl.pallas_call(
@@ -297,8 +320,8 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
@@ -315,8 +338,8 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tp), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, Tp), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, Tp, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
@@ -350,7 +373,11 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
         sm_scale = q.shape[-1] ** -0.5
     o, lse = _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
                                interpret=interpret)
-    return o, (q, k, v, o, lse)
+    # Store the residual COMPACT (B*H, Tp, 1): the lane-replicated
+    # (..., 128) form would be the largest per-layer activation held
+    # across the whole backward (128x a (B, H, T) vector); the backward
+    # re-broadcasts it transiently right before its pallas_call.
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_bwd_rule(causal, sm_scale, interpret, res, do):
@@ -374,15 +401,82 @@ def _jax_tpu_flash(q, k, v, sm_scale):
     favor it, but in the full GPT-2 train step it measures ~15% SLOWER than
     this file's kernel (664 vs 563 ms/step at batch 32) and OOMs at batch
     64 — its backward saves more residuals. Returns None when unavailable
-    so callers fall back to the custom kernel."""
+    so callers fall back to the custom kernel. Sequence lengths that are
+    not 128-aligned (e.g. the Trainer's tiny init dummy batch) are zero
+    padded here; causal masking keeps real queries from seeing the pad."""
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as jflash)
     except ImportError:
         return None
-    if q.shape[2] % 128:
-        return None  # library kernel wants block-aligned sequence lengths
-    return jflash(q, k, v, causal=True, sm_scale=sm_scale)
+    T = q.shape[2]
+    pad_T = (-T) % 128
+    if pad_T:
+        pads = [(0, 0), (0, 0), (0, pad_T), (0, 0)]
+        q, k, v = (jnp.pad(x, pads) for x in (q, k, v))
+    out = jflash(q, k, v, causal=True, sm_scale=sm_scale)
+    return out[:, :, :T, :] if pad_T else out
+
+
+_PALLAS_PROBE: dict[str, bool] = {}
+
+
+def pallas_compile_probe() -> bool:
+    """True iff the custom Pallas kernel (fwd AND bwd) compiles on the
+    current default backend. Compiled once per process per backend; the
+    result gates 'auto' dispatch so one kernel regression can never take
+    down default-config runs (it degrades to the XLA path with a warning).
+
+    Compile-only (AOT lower+compile on tiny shapes), so the probe is cheap
+    and safe to call while tracing an outer jit.
+    """
+    backend = jax.default_backend()
+    if backend in _PALLAS_PROBE:
+        return _PALLAS_PROBE[backend]
+    if backend != "tpu":
+        # Compiled Mosaic kernels only exist on TPU; interpret mode is a
+        # separate explicit impl.
+        _PALLAS_PROBE[backend] = False
+        return False
+    if jax.process_count() > 1:
+        # Multi-host SPMD: a per-host probe could diverge (e.g. one host
+        # fails compile transiently) and hosts would then lower DIFFERENT
+        # programs — a silent hang at the first collective. All hosts
+        # follow process 0's verdict; if a host then genuinely cannot
+        # compile the kernel it fails loudly, which beats divergence.
+        from jax.experimental import multihost_utils
+
+        local = _probe_locally()
+        verdict = bool(multihost_utils.broadcast_one_to_all(
+            jnp.asarray(local)))
+        if verdict and not local:
+            raise RuntimeError(
+                "Pallas flash kernel compiled on process 0 but not on "
+                f"process {jax.process_index()} — refusing to diverge")
+        _PALLAS_PROBE[backend] = verdict
+        return verdict
+    _PALLAS_PROBE[backend] = _probe_locally()
+    return _PALLAS_PROBE[backend]
+
+
+def _probe_locally() -> bool:
+    try:
+        x = jax.ShapeDtypeStruct((1, 1, 128, 64), jnp.bfloat16)
+
+        def fwd(q, k, v):
+            return flash_attention(q, k, v, True, None, False)
+
+        def loss(q, k, v):
+            return fwd(q, k, v).astype(jnp.float32).sum()
+
+        jax.jit(fwd).lower(x, x, x).compile()
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x).compile()
+        return True
+    except Exception as e:  # Mosaic lowering / compile failure
+        warnings.warn(
+            "Pallas flash attention failed to compile on this TPU; "
+            f"falling back to XLA attention. Error: {e}")
+        return False
 
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -391,17 +485,20 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                      dropout_rng: jax.Array | None = None) -> jax.Array:
     """Causal attention over (B, H, T, D) tensors.
 
-    impl: 'auto' (Pallas on TPU, XLA elsewhere), 'pallas', 'pallas_interpret'
-    (for CPU tests), or 'xla'. Attention-probability dropout is only
-    expressible in the XLA path; when active it overrides the impl choice
-    (flash stays the inference/no-dropout fast path).
+    impl: 'auto' (Pallas on TPU when it compiles, XLA otherwise — a probe
+    compiles the kernel once per process so a kernel regression degrades
+    to XLA instead of crashing), 'pallas', 'pallas_interpret' (for CPU
+    tests), 'pallas_jax' (jax's library kernel), or 'xla'.
+    Attention-probability dropout is only expressible in the XLA path;
+    when active it overrides the impl choice (flash stays the
+    inference/no-dropout fast path).
     """
     if dropout_rate > 0.0 and dropout_rng is not None:
         return xla_attention(q, k, v, causal=True, sm_scale=sm_scale,
                              dropout_rate=dropout_rate,
                              dropout_rng=dropout_rng)
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = "pallas" if pallas_compile_probe() else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=True, sm_scale=sm_scale)
     if impl == "pallas":
@@ -411,7 +508,7 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              else q.shape[-1] ** -0.5)
         if out is None:
             raise ValueError("jax library flash kernel unavailable "
-                             "(needs TPU + T % 128 == 0)")
+                             "(requires a TPU backend)")
         return out
     if impl == "pallas_interpret":
         return flash_attention(q, k, v, True, sm_scale, True)

@@ -395,6 +395,13 @@ class Trainer:
         peak = self.peak_flops()
         last_loss = float("nan")
         last_eval: tuple[int, dict] | None = None
+        # --profile_steps=a:b — jax.profiler trace of iters [a, b), written
+        # next to the TB events (the README runbook's profiling workflow;
+        # SURVEY.md §5 tracing hook point). Validated in TrainConfig.
+        self._profiling = False
+        prof_range = cfg.profile_range() if self.is_main else None
+        if prof_range:
+            self.profile_dir = os.path.join(cfg.resolved_log_dir, "profile")
         t0 = time.time()
         try:
             while iter_num < cfg.max_iters:
@@ -418,10 +425,25 @@ class Trainer:
                     if cfg.eval_only:
                         break
 
+                if prof_range and iter_num == prof_range[0]:
+                    jax.profiler.start_trace(self.profile_dir)
+                    self._profiling = True
+
                 xb, yb = next(loader)
                 step_rng = jax.random.fold_in(rng, iter_num)
                 state, metrics = train_step(state, self.to_global(xb),
                                             self.to_global(yb), step_rng)
+
+                if self._profiling and iter_num == prof_range[1] - 1:
+                    # Drain the async queue so the traced window contains
+                    # the device work, then stop.
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                    if self.is_main:
+                        print(f"profiler trace for iters "
+                              f"[{prof_range[0]}:{prof_range[1]}) -> "
+                              f"{self.profile_dir}")
 
                 if cfg.log_interval > 0 and iter_num % cfg.log_interval == 0:
                     loss = float(metrics["loss"])  # sync point
@@ -446,6 +468,9 @@ class Trainer:
                     t0 = time.time()
                 iter_num += 1
         finally:
+            if self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = False
             loader.close()
             writer.close()
 

@@ -1,0 +1,53 @@
+"""Shared train-step throughput measurement for bench.py / perf_sweep.
+
+Pipelined timing: enqueue all timed iters, sync once at the end. This is
+what the real train loop achieves under JAX async dispatch (it only reads
+a scalar back every log_interval); a per-step readback would charge every
+step a host<->device round trip — on a tunneled PJRT transport that RTT
+is ~100ms+ and would understate sustained throughput by ~2x.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
+    """Train `warmup + iters` steps of cfg's model; returns step_ms,
+    tokens_per_sec_per_chip, mfu, and the last loss."""
+    import jax
+
+    from nanosandbox_tpu.train import Trainer
+
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=True)
+    rng = jax.random.key(0)
+    try:
+        for _ in range(warmup):
+            xb, yb = next(loader)
+            state, m = train_step(state, trainer.to_global(xb),
+                                  trainer.to_global(yb), rng)
+        float(m["loss"])  # hard sync: some PJRT transports make
+        # block_until_ready a no-op; a scalar readback always waits.
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            xb, yb = next(loader)
+            state, m = train_step(state, trainer.to_global(xb),
+                                  trainer.to_global(yb), rng)
+        loss = float(m["loss"])
+        step_s = (time.perf_counter() - t0) / iters
+    finally:
+        loader.close()
+
+    n_chips = len(jax.devices())
+    return {
+        "step_ms": round(step_s * 1000, 2),
+        "tokens_per_sec_per_chip": round(
+            cfg.tokens_per_iter / step_s / n_chips, 1),
+        "mfu": round(trainer.flops_per_iter() / step_s
+                     / trainer.peak_flops(), 4),
+        "loss": round(loss, 4),
+    }

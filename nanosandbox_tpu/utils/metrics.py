@@ -32,11 +32,29 @@ class MetricsWriter:
         self.jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a",
                           buffering=1)
         if tensorboard:
-            try:
-                from torch.utils.tensorboard import SummaryWriter
-                self.tb = SummaryWriter(log_dir=self.dir)
-            except Exception:
-                self.tb = None
+            self.tb = self._make_tb_writer(self.dir)
+
+    @staticmethod
+    def _make_tb_writer(log_dir: str):
+        """A SummaryWriter from whichever TB package the image ships.
+
+        tensorboardX first: it is a small pure-python dependency pinned in
+        docker/Dockerfile, so the /data/runs event-file contract
+        (reference README.md:74-87) holds in deployment. torch's writer is
+        a dev-machine fallback only — round 1 imported ONLY torch here and
+        the shipped image has no torch, so TB silently degraded to JSONL
+        (VERDICT.md missing #5).
+        """
+        try:
+            from tensorboardX import SummaryWriter
+            return SummaryWriter(log_dir=log_dir)
+        except Exception:
+            pass
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            return SummaryWriter(log_dir=log_dir)
+        except Exception:
+            return None
 
     def log(self, step: int, scalars: dict[str, Any]) -> None:
         if not self.enabled:

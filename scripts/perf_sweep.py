@@ -1,0 +1,107 @@
+"""Throughput sweep on the current backend: impl x batch x remat x chunk.
+
+Promotes round-1's perf_probe.py scratch script into a proper JSON-emitting
+tool (VERDICT.md next-step #4). Each point trains GPT-2 124M (or a tiny
+model on CPU) for a few timed steps and records tokens/sec/chip + MFU;
+results stream to stdout as JSON lines and are summarized at the end.
+
+Usage:
+    python scripts/perf_sweep.py [--out=sweep.json] [--iters=10]
+        [--impls=pallas,xla] [--batch_sizes=8,16,32,64] [--full]
+
+Default sweeps impl x batch at remat=False/chunk=128, then re-measures the
+winner with remat on/off and chunked vs full loss. --full crosses
+everything (slow).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from nanosandbox_tpu.utils.benchmarking import measure_train_throughput
+
+
+def main(argv: list[str]) -> list[dict]:
+    kv = dict(a.lstrip("-").split("=", 1) for a in argv if "=" in a)
+    full = "--full" in argv
+    import jax
+
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.data.prepare import prepare_char_dataset
+
+    on_tpu = jax.default_backend() == "tpu"
+    tmp = tempfile.mkdtemp(prefix="sweep_")
+    data_dir = os.path.join(tmp, "data")
+    prepare_char_dataset(os.path.join(data_dir, "shakespeare_char"),
+                         allow_synthetic=True,
+                         url="http://invalid.localhost/offline")
+
+    if on_tpu:
+        base = TrainConfig(
+            out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+            dataset="shakespeare_char", vocab_size=50304,
+            n_layer=12, n_head=12, n_embd=768, block_size=1024,
+            max_iters=0, eval_interval=0, dropout=0.0,
+            compute_dtype="bfloat16", tensorboard=False)
+        impls = kv.get("impls", "pallas,xla,pallas_jax").split(",")
+        batches = [int(b) for b in kv.get("batch_sizes", "8,16,32,64").split(",")]
+        warmup, iters = 2, int(kv.get("iters", 10))
+    else:
+        base = TrainConfig(
+            out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+            dataset="shakespeare_char",
+            n_layer=2, n_head=2, n_embd=64, block_size=128,
+            max_iters=0, eval_interval=0, dropout=0.0,
+            compute_dtype="float32", tensorboard=False)
+        impls = kv.get("impls", "xla").split(",")
+        batches = [int(b) for b in kv.get("batch_sizes", "8").split(",")]
+        warmup, iters = 1, int(kv.get("iters", 3))
+
+    results = []
+
+    def run_point(**overrides):
+        cfg = base.replace(**overrides)
+        point = {k: overrides[k] for k in sorted(overrides)}
+        try:
+            point.update(measure_train_throughput(cfg, warmup, iters))
+        except Exception as e:
+            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        print(json.dumps(point), flush=True)
+        results.append(point)
+        return point
+
+    if full:
+        grid = itertools.product(impls, batches, [False, True], [0, 128])
+        for impl, bs, remat, chunk in grid:
+            run_point(attention_impl=impl, batch_size=bs, remat=remat,
+                      loss_chunk_size=chunk)
+    else:
+        for impl, bs in itertools.product(impls, batches):
+            run_point(attention_impl=impl, batch_size=bs)
+        good = [r for r in results if "error" not in r]
+        if good:
+            best = max(good, key=lambda r: r["tokens_per_sec_per_chip"])
+            for remat, chunk in [(True, 128), (False, 0), (True, 0)]:
+                run_point(attention_impl=best["attention_impl"],
+                          batch_size=best["batch_size"], remat=remat,
+                          loss_chunk_size=chunk)
+
+    good = [r for r in results if "error" not in r]
+    if good:
+        best = max(good, key=lambda r: r["tokens_per_sec_per_chip"])
+        print(json.dumps({"best": best}), flush=True)
+    if "out" in kv:
+        with open(kv["out"], "w") as f:
+            json.dump(results, f, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

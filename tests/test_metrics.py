@@ -1,0 +1,61 @@
+"""MetricsWriter: JSONL + TensorBoard event-file contract.
+
+The reference's artifact contract delivers TB event files under /data/runs
+(/root/reference/README.md:74-87). Round 1 imported only torch's writer,
+which the shipped image lacks, so events silently never appeared
+(VERDICT.md missing #5) — these tests pin the contract: a writer in the
+image (tensorboardX) produces real events.out.tfevents* files.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from nanosandbox_tpu.utils.metrics import MetricsWriter
+
+
+def test_jsonl_written(tmp_path):
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=False)
+    w.log(0, {"train/loss": 1.5})
+    w.log(1, {"train/loss": 1.25, "perf/mfu": 0.4})
+    w.close()
+    lines = [json.loads(x) for x in
+             open(tmp_path / "r" / "metrics.jsonl")]
+    assert lines[0]["train/loss"] == 1.5
+    assert lines[1]["step"] == 1 and lines[1]["perf/mfu"] == 0.4
+
+
+def test_tensorboard_event_files_appear(tmp_path):
+    pytest.importorskip("tensorboardX")
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=True)
+    assert w.tb is not None, (
+        "TB writer must construct without torch installed")
+    w.log(0, {"train/loss": 2.0})
+    w.log(1, {"train/loss": 1.0})
+    w.close()
+    events = glob.glob(str(tmp_path / "r" / "events.out.tfevents*"))
+    assert events, "no TB event files written"
+    assert os.path.getsize(events[0]) > 0
+
+
+def test_tensorboard_events_after_training_run(tiny_cfg):
+    """End-to-end: a 2-iter training run leaves event files in
+    resolved_log_dir (the /data/runs deployment contract)."""
+    pytest.importorskip("tensorboardX")
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = tiny_cfg.replace(max_iters=2, tensorboard=True, log_interval=1,
+                           eval_interval=0)
+    Trainer(cfg).run()
+    events = glob.glob(os.path.join(cfg.resolved_log_dir, "*",
+                                    "events.out.tfevents*"))
+    assert events, f"no event files under {cfg.resolved_log_dir}"
+
+
+def test_disabled_writer_is_inert(tmp_path):
+    w = MetricsWriter(str(tmp_path), enabled=False)
+    w.log(0, {"x": 1})
+    w.close()
+    assert not os.listdir(tmp_path)

@@ -1,0 +1,26 @@
+"""Real-TPU test tier (run manually on a chip; NOT part of the CPU suite).
+
+The CPU suite (tests/) can only exercise Pallas kernels in interpret mode,
+which skips Mosaic layout checks — exactly how round 1 shipped a kernel
+that failed lowering on hardware with a green suite (VERDICT.md weak #5).
+This tier compiles the real kernels. Usage, on a machine with a TPU:
+
+    python -m pytest tests_tpu/ -q
+
+Skips everything (collection-time) when no TPU backend is available, so
+accidentally running it on CI is a no-op, not a failure.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        skip = pytest.mark.skip(reason="requires a real TPU backend")
+        for item in items:
+            item.add_marker(skip)
