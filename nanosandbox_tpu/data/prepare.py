@@ -178,7 +178,11 @@ def main(argv: list[str] | None = None) -> None:
     # by default (reference scale-down philosophy). openwebtext is a REAL
     # training corpus: silent synthetic data would invalidate runs, so it
     # fails loudly unless explicitly allowed (env for the k8s Job).
-    ap.add_argument("--allow_synthetic", default=None, action="store_true")
+    # BooleanOptionalAction so BOTH directions are expressible on the CLI
+    # (--allow_synthetic / --no-allow_synthetic); None falls through to the
+    # DATASET_ALLOW_SYNTHETIC env var, then the per-dataset default.
+    ap.add_argument("--allow_synthetic", default=None,
+                    action=argparse.BooleanOptionalAction)
     args = ap.parse_args(argv)
     allow_synth = args.allow_synthetic
     if allow_synth is None:

