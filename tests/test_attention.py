@@ -69,3 +69,32 @@ def test_bf16_inputs():
     out = flash_attention(q, k, v, True, None, True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32), atol=3e-2)
+
+
+@pytest.mark.parametrize("T,expect", [
+    (64, (128, 128)),     # tiny T -> single 128 block
+    (640, (128, 128)),    # 640 = 5*128: only 128 divides -> no pad waste
+    (768, (384, 384)),    # largest divisor <= 512
+    (1024, (512, 512)),
+    (8192, (512, 512)),
+])
+def test_clamp_blocks_divides_padded_T(T, expect):
+    from nanosandbox_tpu.ops.attention import _clamp_blocks, DEFAULT_BLOCK
+
+    got = _clamp_blocks(T, DEFAULT_BLOCK, DEFAULT_BLOCK)
+    assert got == expect
+    Tp128 = -(-T // 128) * 128
+    assert Tp128 % got[0] == 0 and Tp128 % got[1] == 0
+
+
+@pytest.mark.parametrize("T", [640, 320])
+def test_flash_matches_xla_non_divisor_T(T):
+    """T between block multiples must not pad past the 128 boundary
+    (would waste FLOPs on pad query rows and change nothing numerically —
+    this pins the parity either way)."""
+    rng = np.random.default_rng(7)
+    q, k, v = rand_qkv(rng, B=1, H=2, T=T, D=32)
+    ref = xla_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
