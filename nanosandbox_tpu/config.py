@@ -82,6 +82,10 @@ class TrainConfig:
     mesh_fsdp: int = 1
     mesh_tp: int = 1
     mesh_sp: int = 1  # sequence/context parallel (attention_impl='ring')
+    # 'zigzag' balances per-device causal work (each device owns one early
+    # + one late half-chunk); 'contiguous' keeps plain chunking. Zigzag
+    # falls back to contiguous when block_size % (2*mesh_sp) != 0.
+    ring_layout: str = "zigzag"
     shard_params: bool = False  # FSDP: shard params/opt-state over fsdp axis
 
     # -- distributed bootstrap (SURVEY.md §2.6; entrypoint derives these).
@@ -226,6 +230,7 @@ class GPTConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     attention_impl: str = "auto"
+    ring_layout: str = "zigzag"
     remat: bool = False
 
     @classmethod
@@ -241,6 +246,7 @@ class GPTConfig:
             param_dtype=cfg.param_dtype,
             compute_dtype=cfg.compute_dtype,
             attention_impl=cfg.attention_impl,
+            ring_layout=cfg.ring_layout,
             remat=cfg.remat,
         )
 

@@ -63,7 +63,8 @@ class CausalSelfAttention(nn.Module):
                     "attention_impl='ring' needs an active mesh — construct "
                     "the model via Trainer, or call "
                     "parallel.mesh.set_current_mesh(make_mesh(...)) first")
-            y = ring_attention_sharded(q, k, v, mesh=mesh)
+            y = ring_attention_sharded(q, k, v, mesh=mesh,
+                                       layout=cfg.ring_layout)
         else:
             attn_rng = None
             if cfg.dropout > 0.0 and not deterministic:

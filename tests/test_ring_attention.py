@@ -139,3 +139,84 @@ def test_trainer_validates_ring_config(tiny_cfg):
 
 def teardown_module():
     set_current_mesh(None)
+
+
+# -- zigzag layout (VERDICT.md round-1 stretch #10) -----------------------
+
+def test_zigzag_permutation_inverse():
+    from nanosandbox_tpu.ops.ring_attention import zigzag_permutation
+
+    idx, inv = zigzag_permutation(64, 4)
+    x = np.arange(64)
+    assert (x[idx][inv] == x).all()
+    # device 0's shard = first early + last late half-chunk
+    h = 64 // 8
+    assert (idx[:h] == np.arange(0, h)).all()
+    assert (idx[h:2 * h] == np.arange(64 - h, 64)).all()
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+@pytest.mark.parametrize("layout", ["zigzag", "contiguous"])
+def test_ring_layouts_match_xla_forward(sp, layout):
+    mesh = make_mesh(mesh_dp=1, mesh_sp=sp, devices=jax.devices()[:sp])
+    q, k, v = _qkv(seed=3)
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh=mesh, layout=layout))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_matches_xla_gradients():
+    mesh = make_mesh(mesh_dp=2, mesh_sp=4)
+    q, k, v = _qkv(seed=4)
+
+    def loss_zig(q, k, v):
+        return (ring_attention_sharded(q, k, v, mesh=mesh,
+                                       layout="zigzag") ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_zig = jax.jit(jax.grad(loss_zig, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_zig, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_zigzag_falls_back_when_T_not_2cp_divisible():
+    """T=40 with sp=4: divisible by cp but not 2*cp — zigzag silently
+    uses the (exact) contiguous path."""
+    mesh = make_mesh(mesh_dp=2, mesh_sp=4)
+    q, k, v = _qkv(T=40, seed=5)
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh=mesh, layout="zigzag"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_end_to_end_training_matches_dp(tiny_cfg):
+    """Tiny GPT under mesh_sp=4 + zigzag ring: first-step loss matches a
+    plain-DP run on identical data (layout is invisible to the math)."""
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = tiny_cfg.replace(batch_size=8, mesh_dp=2, mesh_sp=4,
+                           attention_impl="ring", ring_layout="zigzag")
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    train_step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=False)
+    xb, yb = next(loader)
+    _, m = train_step(state, trainer.to_global(xb), trainer.to_global(yb),
+                      jax.random.key(0))
+
+    cfg2 = tiny_cfg.replace(batch_size=8, mesh_dp=8)
+    t2 = Trainer(cfg2)
+    s2 = t2.init_state()
+    step2, _ = t2.compiled_steps()
+    loader2 = t2.make_loader("train", prefetch=False)
+    xb2, yb2 = next(loader2)
+    _, m2 = step2(s2, t2.to_global(xb2), t2.to_global(yb2), jax.random.key(0))
+    assert float(m2["loss"]) == pytest.approx(float(m["loss"]), rel=1e-4)
