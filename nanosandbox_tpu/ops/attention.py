@@ -153,6 +153,11 @@ def _clamp_blocks(T: int, block_q: int, block_k: int) -> tuple[int, int]:
     Tp128 = -(-T // LANES) * LANES
 
     def pick(b: int) -> int:
+        # Round a caller-supplied block down to the LANES grid first: the
+        # divisor search below steps by LANES and only terminates from a
+        # LANES multiple (e.g. b=200 would step 200,72,... past 128 and
+        # never divide Tp128).
+        b = max(LANES, b // LANES * LANES)
         b = min(b, Tp128)
         while Tp128 % b:
             b -= LANES  # terminates at 128, which always divides Tp128
@@ -470,6 +475,14 @@ def pallas_compile_probe() -> bool:
 
     Compile-only (AOT lower+compile on tiny shapes), so the probe is cheap
     and safe to call while tracing an outer jit.
+
+    Multi-host note: with process_count > 1 the probe runs a cross-process
+    broadcast so all hosts agree on one verdict — every process that built
+    the distributed runtime MUST reach its first attention call, or the
+    barrier deadlocks. A single-process diagnostic tool running inside an
+    initialized multi-process runtime (e.g. a rank-0-only script) should
+    set NANOSANDBOX_ATTENTION_PROBE=local to skip the collective (or pin
+    --attention_impl explicitly, which never probes).
     """
     backend = jax.default_backend()
     if backend in _PALLAS_PROBE:
@@ -479,6 +492,11 @@ def pallas_compile_probe() -> bool:
         # separate explicit impl.
         _PALLAS_PROBE[backend] = False
         return False
+    import os
+
+    if os.environ.get("NANOSANDBOX_ATTENTION_PROBE") == "local":
+        _PALLAS_PROBE[backend] = _probe_locally()
+        return _PALLAS_PROBE[backend]
     if jax.process_count() > 1:
         # Multi-host SPMD: a per-host probe could diverge (e.g. one host
         # fails compile transiently) and hosts would then lower DIFFERENT

@@ -133,6 +133,15 @@ class Trainer:
             raise ValueError(
                 f"batch_size*accum {cfg.sequences_per_iter} must be "
                 f"divisible by num_processes ({self.process_count})")
+        if cfg.batch_size % self.process_count:
+            # estimate_loss builds per-process eval batches of
+            # batch_size // process_count rows; accumulation does NOT
+            # carry the divisibility there, so a config like batch 2 /
+            # accum 4 / 8 processes would crash mid-run at the first
+            # eval with a 0-row batch. Fail at construction instead.
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must be divisible by "
+                f"num_processes ({self.process_count}) for evaluation")
         if cfg.block_size % self.mesh.shape["seq"]:
             raise ValueError(
                 f"block_size {cfg.block_size} must be divisible by the "
@@ -436,8 +445,11 @@ class Trainer:
 
                 if self._profiling and iter_num == prof_range[1] - 1:
                     # Drain the async queue so the traced window contains
-                    # the device work, then stop.
-                    jax.block_until_ready(metrics["loss"])
+                    # the device work, then stop. Scalar readback, not
+                    # block_until_ready: some PJRT transports make the
+                    # latter a no-op (see utils/benchmarking.py), which
+                    # would stop the trace before the device work lands.
+                    float(metrics["loss"])
                     jax.profiler.stop_trace()
                     self._profiling = False
                     if self.is_main:

@@ -19,6 +19,12 @@ def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
 
     from nanosandbox_tpu.train import Trainer
 
+    if warmup < 1:
+        # The hard-sync below reads the last warmup step's metrics; with
+        # no warmup there is nothing to sync on and t0 would include
+        # compilation.
+        raise ValueError("measure_train_throughput requires warmup >= 1")
+
     trainer = Trainer(cfg)
     state = trainer.init_state()
     train_step, _ = trainer.compiled_steps()

@@ -37,6 +37,7 @@ def main(argv: list[str]) -> list[dict]:
     from nanosandbox_tpu.data.prepare import prepare_char_dataset
 
     on_tpu = jax.default_backend() == "tpu"
+    n_chips = len(jax.devices())
     tmp = tempfile.mkdtemp(prefix="sweep_")
     data_dir = os.path.join(tmp, "data")
     prepare_char_dataset(os.path.join(data_dir, "shakespeare_char"),
@@ -67,8 +68,16 @@ def main(argv: list[str]) -> list[dict]:
     results = []
 
     def run_point(**overrides):
-        cfg = base.replace(**overrides)
+        # batch_size values are PER-CHIP (same semantics as bench.py, so
+        # sweep points stay comparable to bench output on any host size);
+        # the global batch scales with the chip count. The recorded point
+        # keeps the per-chip value so re-feeding a winner doesn't rescale.
         point = {k: overrides[k] for k in sorted(overrides)}
+        if "batch_size" in overrides:
+            overrides = dict(overrides,
+                             batch_size=overrides["batch_size"] * n_chips)
+        cfg = base.replace(**overrides)
+        point["global_batch_size"] = cfg.batch_size
         try:
             point.update(measure_train_throughput(cfg, warmup, iters))
         except Exception as e:

@@ -1,16 +1,31 @@
-"""Worker for the real 2-process jax.distributed test (not collected).
+"""Worker for the real multi-process jax.distributed tests (not collected).
 
 Run by tests/test_distributed.py in N subprocesses with the exact
 environment container/entrypoint.sh exports in a StatefulSet pod:
 COORDINATOR_ADDRESS + NUM_PROCESSES set, PROCESS_ID derived from the
 HOSTNAME ordinal (train-multipod-<i>). Each process runs the SAME program
 (SPMD), initializes the distributed runtime through the Trainer's normal
-bootstrap path (parallel/distributed.py), executes one data-parallel
-train step on its own batch shard, and prints the globally-reduced loss.
-The parent asserts every process printed the identical value — the
-allreduce that DDP/NCCL did per-step, done by the XLA partitioner.
+bootstrap path (parallel/distributed.py), executes one train step, and
+prints the globally-reduced loss. The parent asserts every process printed
+the identical value — the allreduce that DDP/NCCL did per-step, done by
+the XLA partitioner.
 
-usage: _dist_worker.py <data_dir> <out_dir>
+Modes (argv[3], default "dp"):
+  dp        1 local device/process, pure data parallel (the round-2 test).
+  fsdp8     4 local devices/process, mesh fsdp=8 + shard_params: the fsdp
+            axis SPANS the process boundary (params live half on each
+            process, grads reduce-scatter across it) — the StatefulSet
+            topology a v5e-16 FSDP run has (round-2 VERDICT weak #6).
+  fsdp4sp2  4 local devices/process, mesh fsdp=4 x sp=2 with ring
+            attention: sequence-parallel ppermute + FSDP collectives in
+            one multi-process program.
+
+In the multi-device modes the batch is sampled with dataset.sample_batch
+(global, topology-independent) and row-sliced per process, so the parent
+can run the IDENTICAL global batch single-process and assert loss parity,
+not just cross-process agreement.
+
+usage: _dist_worker.py <data_dir> <out_dir> [mode]
 """
 
 import os
@@ -25,19 +40,34 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def main() -> None:
-    data_dir, out_dir = sys.argv[1], sys.argv[2]
-
+def worker_config(mode: str, data_dir: str, out_dir: str):
     from nanosandbox_tpu.config import TrainConfig
-    from nanosandbox_tpu.train import Trainer
 
-    cfg = TrainConfig(
+    base = dict(
         out_dir=out_dir, data_dir=data_dir, dataset="shakespeare_char",
         n_layer=2, n_head=2, n_embd=64, block_size=64,
         batch_size=4, max_iters=1, eval_interval=0, log_interval=1,
         warmup_iters=1, lr_decay_iters=1, dropout=0.0,
         compute_dtype="float32", tensorboard=False, device="cpu")
+    if mode == "dp":
+        pass
+    elif mode == "fsdp8":
+        base.update(batch_size=8, mesh_fsdp=8, shard_params=True)
+    elif mode == "fsdp4sp2":
+        base.update(batch_size=8, mesh_fsdp=4, mesh_sp=2,
+                    shard_params=True, attention_impl="ring")
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    return TrainConfig(**base)
 
+
+def main() -> None:
+    data_dir, out_dir = sys.argv[1], sys.argv[2]
+    mode = sys.argv[3] if len(sys.argv) > 3 else "dp"
+
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = worker_config(mode, data_dir, out_dir)
     trainer = Trainer(cfg)  # bootstraps jax.distributed from env
     assert trainer.multi_host, "expected multi-process initialization"
     assert trainer.process_count == 2, trainer.process_count
@@ -46,16 +76,42 @@ def main() -> None:
 
     state = trainer.init_state()
     train_step, _ = trainer.compiled_steps()
-    loader = trainer.make_loader("train", prefetch=False)
-    try:
-        xb, yb = next(loader)
-        state, metrics = train_step(state, trainer.to_global(xb),
-                                    trainer.to_global(yb),
-                                    jax.random.key(0))
-        print(f"DIST_LOSS {float(metrics['loss']):.8f}")
-        print(f"DIST_GRADNORM {float(metrics['grad_norm']):.8f}")
-    finally:
-        loader.close()
+
+    if mode == "dp":
+        loader = trainer.make_loader("train", prefetch=False)
+        try:
+            xb, yb = next(loader)
+        finally:
+            loader.close()
+    else:
+        # Topology-independent batch: sample the GLOBAL batch with a
+        # pinned seed and keep this process's row slice (batch rows are
+        # laid out process-major over the (data, fsdp) shards), so the
+        # parent can replay the identical batch single-process.
+        xg, yg = trainer.dataset.sample_batch(
+            "train", 0, cfg.batch_size, cfg.block_size, seed=cfg.seed)
+        rows = cfg.batch_size // trainer.process_count
+        lo = trainer.process_index * rows
+        xb, yb = xg[lo:lo + rows], yg[lo:lo + rows]
+
+    if mode in ("fsdp8", "fsdp4sp2"):
+        # The param shards must actually SPAN the process boundary: each
+        # process addresses only its local devices' shards of a
+        # globally-sharded kernel.
+        kernel = state["params"]["h_0"]["attn"]["c_attn"]["kernel"]
+        n_local = len(kernel.addressable_shards)
+        total = kernel.sharding.num_devices
+        shard_shape = kernel.addressable_shards[0].data.shape
+        assert total == jax.device_count(), (total, jax.device_count())
+        assert n_local == jax.local_device_count(), n_local
+        assert shard_shape != kernel.shape, "param not sharded"
+        print(f"FSDP_SPAN local_shards={n_local} global_devices={total} "
+              f"shard={shard_shape} full={tuple(kernel.shape)}")
+
+    state, metrics = train_step(state, trainer.to_global(xb),
+                                trainer.to_global(yb), jax.random.key(0))
+    print(f"DIST_LOSS {float(metrics['loss']):.8f}")
+    print(f"DIST_GRADNORM {float(metrics['grad_norm']):.8f}")
 
 
 if __name__ == "__main__":

@@ -87,6 +87,19 @@ def test_clamp_blocks_divides_padded_T(T, expect):
     assert Tp128 % got[0] == 0 and Tp128 % got[1] == 0
 
 
+@pytest.mark.parametrize("block", [200, 8, 1, 129, 511])
+def test_clamp_blocks_off_grid_request_terminates(block):
+    """Caller-supplied blocks off the 128-lane grid (e.g. 200, which
+    passes _pad_qkv's %8 check) used to make the divisor search loop
+    forever / go negative (ADVICE r2); they now round down to the grid."""
+    from nanosandbox_tpu.ops.attention import _clamp_blocks
+
+    bq, bk = _clamp_blocks(1024, block, block)
+    assert bq % 128 == 0 and bk % 128 == 0
+    assert bq >= 128 and bk >= 128
+    assert 1024 % bq == 0 and 1024 % bk == 0
+
+
 @pytest.mark.parametrize("T", [640, 320])
 def test_flash_matches_xla_non_divisor_T(T):
     """T between block multiples must not pad past the 128 boundary
