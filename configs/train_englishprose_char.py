@@ -1,0 +1,22 @@
+# BASELINE row-1 shape (6L/6H/384d char-level GPT, the reference's
+# shakespeare-char config) trained on the committed REAL English corpus
+# (data/fixtures/english_prose.txt; see data/fixtures/PROVENANCE.md) —
+# the zero-egress stand-in for tiny-shakespeare that makes the val-loss
+# half of the parity metric measurable on real natural language.
+out_dir = "out/englishprose_char"
+dataset = "english_prose_char"
+n_layer = 6
+n_head = 6
+n_embd = 384
+block_size = 256
+batch_size = 64
+dropout = 0.2
+max_iters = 5000
+lr_decay_iters = 5000
+eval_interval = 250
+eval_iters = 200
+log_interval = 10
+warmup_iters = 100
+learning_rate = 1e-3
+min_lr = 1e-4
+beta2 = 0.99

@@ -105,6 +105,32 @@ def prepare_char_dataset(out_dir: str, source_file: str | None = None,
     return write_bins(ids, out_dir, tok.meta())
 
 
+REAL_FIXTURE = os.path.join("data", "fixtures", "english_prose.txt")
+
+
+def prepare_english_prose_dataset(out_dir: str,
+                                  source_file: str | None = None) -> dict:
+    """Char-level prep of the committed REAL-text fixture.
+
+    The zero-egress counterpart of the tiny-shakespeare flow
+    (the reference notebook downloads its corpus over the network;
+    this environment cannot): ``scripts/make_real_corpus.py`` assembles
+    ~4 MB of human-written English from redistributable in-image prose
+    and commits it at data/fixtures/english_prose.txt. No synthetic
+    fallback — real data or a loud failure.
+    """
+    src = source_file or REAL_FIXTURE
+    if not os.path.exists(src):
+        raise FileNotFoundError(
+            f"{src} not found — run `python scripts/make_real_corpus.py` "
+            "(or pass --source_file) to build the real-text fixture")
+    with open(src, "r", encoding="utf-8") as f:
+        text = f.read()
+    tok = CharTokenizer.from_text(text)
+    ids = np.asarray(tok.encode(text), dtype=np.uint16)
+    return write_bins(ids, out_dir, tok.meta())
+
+
 def download_openwebtext(num_chars: int, dataset_name: str = "Skylion007/openwebtext"
                          ) -> str:
     """Stream an OpenWebText subset via HF datasets (backlog #22's "small
@@ -168,7 +194,8 @@ def main(argv: list[str] | None = None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="prepare dataset bins")
-    ap.add_argument("dataset", choices=["shakespeare_char", "openwebtext"])
+    ap.add_argument("dataset", choices=["shakespeare_char", "openwebtext",
+                                        "english_prose_char"])
     ap.add_argument("--data_dir", default=os.environ.get("DATA_DIR", "data"))
     ap.add_argument("--source_file", default=None)
     ap.add_argument("--num_chars", type=int,
@@ -190,7 +217,10 @@ def main(argv: list[str] | None = None) -> None:
         allow_synth = (env == "1") if env else (args.dataset == "shakespeare_char")
 
     out_dir = os.path.join(args.data_dir, args.dataset)
-    if args.dataset == "shakespeare_char":
+    if args.dataset == "english_prose_char":
+        stats = prepare_english_prose_dataset(out_dir,
+                                              source_file=args.source_file)
+    elif args.dataset == "shakespeare_char":
         stats = prepare_char_dataset(out_dir, source_file=args.source_file,
                                      allow_synthetic=allow_synth)
     else:

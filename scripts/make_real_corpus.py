@@ -1,0 +1,211 @@
+#!/usr/bin/env python3
+"""Assemble a REAL English-text training corpus from redistributable prose
+already present in the image — the zero-egress stand-in for tiny-shakespeare.
+
+Why this exists: the parity metric is "tokens/sec/chip + final val loss"
+(BASELINE.json), and the val-loss half is only meaningful on real natural
+language. The reference obtains tiny-shakespeare over the network
+(its notebook downloads the corpus before training); this environment has
+no egress, so instead we harvest human-written English that ships inside
+the image and is licensed for verbatim redistribution:
+
+  * documentation files (*.rst, *.md, *.txt) bundled in site-packages
+    (numpy/scipy/jax/... docs — BSD/Apache/PSF licensed),
+  * the FSF license texts in /usr/share/common-licenses (verbatim
+    redistribution explicitly permitted),
+  * module/class/function docstrings extracted (via ast, no imports) from
+    the .py sources of mainstream scientific-Python packages — genuine
+    human-authored English prose under the same permissive licenses.
+
+Everything goes through a prose filter (drops code blocks, tables, markup
+lines), is deduplicated at paragraph granularity, normalized to printable
+ASCII (keeps the char-level vocab ~90 symbols, matching the
+shakespeare-char regime), and emitted in a deterministic order with a
+provenance manifest. The result is real English with natural statistics —
+word frequencies, syntax, punctuation — on which a char-level LM's val
+loss is a meaningful number.
+
+Usage:  python scripts/make_real_corpus.py [--out data/fixtures/english_prose.txt]
+                                           [--max_mb 4.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import glob
+import hashlib
+import os
+import re
+import sys
+import sysconfig
+
+# Packages whose .py docstrings we harvest. Pinned (not "everything in
+# site-packages") so the corpus is reproducible and its licensing is
+# auditable: all are BSD-3/Apache-2.0/PSF projects.
+DOCSTRING_PACKAGES = [
+    "numpy", "scipy", "jax", "flax", "optax", "chex", "pandas",
+    "sklearn", "matplotlib", "einops", "orbax",
+]
+
+DOC_GLOBS = ["**/*.rst", "**/*.md", "**/LICENSE*", "**/*.txt"]
+
+_PRINTABLE = set(chr(c) for c in range(32, 127)) | {"\n"}
+
+# Lines that are markup/code rather than prose.
+_NONPROSE_LINE = re.compile(
+    r"^\s*(>>>|\.\.\s|:[a-z]+:|[-=~^`#*+_|]{4,}\s*$|\||\+[-+]|@|def |class "
+    r"|import |from |return |assert |\$ |#include|//|/\*)")
+
+
+def _ascii_clean(text: str) -> str:
+    out = []
+    for ch in text:
+        if ch in _PRINTABLE:
+            out.append(ch)
+        elif ch in "‘’":
+            out.append("'")
+        elif ch in "“”":
+            out.append('"')
+        elif ch in "–—":
+            out.append("-")
+        elif ch == "\t":
+            out.append("  ")
+        # other non-ASCII dropped (corpus stays char-vocab friendly)
+    return "".join(out)
+
+
+def _is_prose_paragraph(par: str) -> bool:
+    """Keep paragraphs that read like English sentences."""
+    if len(par) < 120:
+        return False
+    lines = par.split("\n")
+    bad = sum(1 for ln in lines if _NONPROSE_LINE.match(ln))
+    if bad * 3 > len(lines):
+        return False
+    letters = sum(c.isalpha() for c in par)
+    if letters / len(par) < 0.62:
+        return False
+    words = par.split()
+    if not words:
+        return False
+    avg = sum(len(w) for w in words) / len(words)
+    if not (2.5 <= avg <= 9.5):
+        return False
+    # Real sentences contain common function words.
+    lower = par.lower()
+    hits = sum(1 for w in (" the ", " a ", " of ", " is ", " to ", " and ",
+                           " in ", " that ", " for ") if w in lower)
+    return hits >= 3
+
+
+def _paragraphs(text: str):
+    text = _ascii_clean(text)
+    for par in re.split(r"\n\s*\n", text):
+        par = "\n".join(ln.rstrip() for ln in par.strip("\n").split("\n"))
+        if par:
+            yield par
+
+
+def harvest_doc_files(roots: list[str], any_name: bool = False):
+    files = []
+    for root in roots:
+        for pat in (["*"] if any_name else DOC_GLOBS):
+            files.extend(glob.glob(os.path.join(root, pat), recursive=True))
+    files = [f for f in files if os.path.isfile(f)]
+    for path in sorted(set(files)):
+        try:
+            if os.path.getsize(path) > 2_000_000:
+                continue
+            with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                yield path, f.read()
+        except OSError:
+            continue
+
+
+def harvest_docstrings(site: str):
+    for pkg in DOCSTRING_PACKAGES:
+        pkg_dir = os.path.join(site, pkg)
+        if not os.path.isdir(pkg_dir):
+            continue
+        for path in sorted(glob.glob(os.path.join(pkg_dir, "**/*.py"),
+                                     recursive=True)):
+            try:
+                with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                    src = f.read()
+                tree = ast.parse(src)
+            except (OSError, SyntaxError):
+                continue
+            parts = []
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef, ast.AsyncFunctionDef)):
+                    doc = ast.get_docstring(node)
+                    if doc and len(doc) >= 200:
+                        parts.append(doc)
+            if parts:
+                yield path, "\n\n".join(parts)
+
+
+def build(out_path: str, max_bytes: int) -> dict:
+    site = sysconfig.get_paths()["purelib"]
+    sources = [
+        ("licenses", harvest_doc_files(["/usr/share/common-licenses"],
+                                       any_name=True)),
+        ("package-docs", harvest_doc_files([site])),
+        ("docstrings", harvest_docstrings(site)),
+    ]
+    seen: set[bytes] = set()
+    chunks: list[str] = []
+    stats = {name: {"files": 0, "bytes": 0} for name, _ in sources}
+    manifest: list[str] = []
+    total = 0
+    for name, it in sources:
+        for path, text in it:
+            kept = []
+            for par in _paragraphs(text):
+                h = hashlib.sha1(par.encode()).digest()
+                if h in seen or not _is_prose_paragraph(par):
+                    continue
+                seen.add(h)
+                kept.append(par)
+            if not kept:
+                continue
+            doc = "\n\n".join(kept) + "\n\n"
+            chunks.append(doc)
+            stats[name]["files"] += 1
+            stats[name]["bytes"] += len(doc)
+            manifest.append(f"{name}\t{path}\t{len(doc)}")
+            total += len(doc)
+            if total >= max_bytes:
+                break
+        if total >= max_bytes:
+            break
+
+    corpus = "".join(chunks)[:max_bytes]
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(corpus)
+    with open(out_path + ".manifest", "w", encoding="utf-8") as f:
+        f.write("# source\tpath\tbytes_contributed\n")
+        f.write("\n".join(manifest) + "\n")
+    vocab = sorted(set(corpus))
+    return {"bytes": len(corpus), "vocab_size": len(vocab),
+            "stats": stats, "out": out_path}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/fixtures/english_prose.txt")
+    ap.add_argument("--max_mb", type=float, default=4.0)
+    args = ap.parse_args(argv)
+    info = build(args.out, int(args.max_mb * 1e6))
+    print(f"wrote {info['out']}: {info['bytes']:,} bytes, "
+          f"char vocab {info['vocab_size']}")
+    for name, s in info["stats"].items():
+        print(f"  {name}: {s['files']} files, {s['bytes']:,} bytes")
+    return info
+
+
+if __name__ == "__main__":
+    main()
