@@ -105,7 +105,14 @@ def prepare_char_dataset(out_dir: str, source_file: str | None = None,
     return write_bins(ids, out_dir, tok.meta())
 
 
-REAL_FIXTURE = os.path.join("data", "fixtures", "english_prose.txt")
+# Resolved relative to the repo checkout (this file lives at
+# <repo>/nanosandbox_tpu/data/prepare.py), not the CWD, so the
+# english_prose_char prep works from any working directory — e.g. the
+# k8s dataset Job runs it with the PVC as CWD.
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+REAL_FIXTURE = os.path.join(_REPO_ROOT, "data", "fixtures",
+                            "english_prose.txt")
 
 
 def prepare_english_prose_dataset(out_dir: str,

@@ -147,12 +147,31 @@ def harvest_docstrings(site: str):
                 yield path, "\n\n".join(parts)
 
 
+# Import name -> distribution name where they differ (dist-info dirs are
+# named after the distribution).
+_DIST_NAMES = {"sklearn": "scikit_learn", "orbax": "orbax_checkpoint"}
+
+
+def _allowed_doc_roots(site: str) -> list[str]:
+    """Doc-file harvesting is restricted to the SAME pinned package list
+    as docstrings (plus those packages' dist-info license files) so the
+    redistribution claim in data/fixtures/PROVENANCE.md is enforced by
+    code, not assumed — an unvetted transitive dependency in the image
+    can never leak into the corpus."""
+    roots = []
+    for pkg in DOCSTRING_PACKAGES:
+        roots.append(os.path.join(site, pkg))
+        dist = _DIST_NAMES.get(pkg, pkg)
+        roots.extend(glob.glob(os.path.join(site, dist + "-*.dist-info")))
+    return [r for r in roots if os.path.isdir(r)]
+
+
 def build(out_path: str, max_bytes: int) -> dict:
     site = sysconfig.get_paths()["purelib"]
     sources = [
         ("licenses", harvest_doc_files(["/usr/share/common-licenses"],
                                        any_name=True)),
-        ("package-docs", harvest_doc_files([site])),
+        ("package-docs", harvest_doc_files(_allowed_doc_roots(site))),
         ("docstrings", harvest_docstrings(site)),
     ]
     seen: set[bytes] = set()
@@ -173,9 +192,14 @@ def build(out_path: str, max_bytes: int) -> dict:
                 continue
             doc = "\n\n".join(kept) + "\n\n"
             chunks.append(doc)
+            # Record what actually lands in the emitted file: the final
+            # document may be cut by the [:max_bytes] truncation below,
+            # and the manifest's bytes_contributed column must sum to the
+            # corpus size.
+            contrib = min(len(doc), max_bytes - total)
             stats[name]["files"] += 1
-            stats[name]["bytes"] += len(doc)
-            manifest.append(f"{name}\t{path}\t{len(doc)}")
+            stats[name]["bytes"] += contrib
+            manifest.append(f"{name}\t{path}\t{contrib}")
             total += len(doc)
             if total >= max_bytes:
                 break

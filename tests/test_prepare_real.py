@@ -87,3 +87,32 @@ def test_bpe_prep_on_real_text(corpus, tmp_path):
         assert stats["vocab_size"] == 50257
         total = stats["train_tokens"] + stats["val_tokens"]
         assert 90_000 < total < 170_000  # ~3-5.5 chars/token on English
+
+
+def test_manifest_accounts_for_every_corpus_byte():
+    """The provenance manifest's bytes_contributed column must sum to the
+    emitted corpus size exactly (the final document is cut by the
+    max_bytes truncation and must be recorded post-cut), and every
+    site-packages path must belong to the pinned allowlist that makes
+    the PROVENANCE.md redistribution claim auditable."""
+    manifest = FIXTURE + ".manifest"
+    assert os.path.exists(manifest)
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from make_real_corpus import _DIST_NAMES, DOCSTRING_PACKAGES
+
+    allowed = set(DOCSTRING_PACKAGES) | set(_DIST_NAMES.values())
+
+    total = 0
+    with open(manifest) as f:
+        for line in f:
+            if line.startswith("#") or not line.strip():
+                continue
+            _, path, nbytes = line.rsplit("\t", 2)[-3:]
+            total += int(nbytes)
+            if "/site-packages/" in path:
+                pkg = path.split("/site-packages/")[1].split("/")[0]
+                pkg = pkg.split("-")[0]  # foo-1.2.dist-info -> foo
+                assert pkg in allowed, (
+                    f"unpinned package in corpus provenance: {path}")
+    assert total == os.path.getsize(FIXTURE)
