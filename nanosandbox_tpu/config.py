@@ -71,6 +71,11 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # MXU-native
     attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla' | 'ring'
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    # What remat saves: 'save_attention' keeps each block's attention
+    # output (tagged checkpoint_name) so the backward never re-runs the
+    # O(T^2) kernel — attention is the one sub-computation whose recompute
+    # cost dwarfs its activation size; 'full' recomputes everything.
+    remat_policy: str = "save_attention"
     # Fused LM-head + cross-entropy, scanned over sequence chunks of this
     # many positions so full (B, T, vocab) logits never hit HBM. 0 disables
     # (plain full-logits loss). Auto-disabled under sequence parallelism.
@@ -86,6 +91,10 @@ class TrainConfig:
     # + one late half-chunk); 'contiguous' keeps plain chunking. Zigzag
     # falls back to contiguous when block_size % (2*mesh_sp) != 0.
     ring_layout: str = "zigzag"
+    # Per-block math inside the ring: 'auto' uses the Pallas flash kernel
+    # when it compiles and the local chunk is 128-aligned (XLA einsum
+    # otherwise); 'xla' | 'pallas' | 'pallas_interpret' pin it.
+    ring_block_impl: str = "auto"
     shard_params: bool = False  # FSDP: shard params/opt-state over fsdp axis
 
     # -- distributed bootstrap (SURVEY.md §2.6; entrypoint derives these).
@@ -231,7 +240,9 @@ class GPTConfig:
     compute_dtype: str = "bfloat16"
     attention_impl: str = "auto"
     ring_layout: str = "zigzag"
+    ring_block_impl: str = "auto"
     remat: bool = False
+    remat_policy: str = "save_attention"
 
     @classmethod
     def from_train_config(cls, cfg: TrainConfig, vocab_size: int) -> "GPTConfig":
@@ -247,7 +258,9 @@ class GPTConfig:
             compute_dtype=cfg.compute_dtype,
             attention_impl=cfg.attention_impl,
             ring_layout=cfg.ring_layout,
+            ring_block_impl=cfg.ring_block_impl,
             remat=cfg.remat,
+            remat_policy=cfg.remat_policy,
         )
 
 

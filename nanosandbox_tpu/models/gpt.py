@@ -63,8 +63,18 @@ class CausalSelfAttention(nn.Module):
                     "attention_impl='ring' needs an active mesh — construct "
                     "the model via Trainer, or call "
                     "parallel.mesh.set_current_mesh(make_mesh(...)) first")
+            if cfg.dropout > 0.0 and not deterministic:
+                # Trainer validates this at construction; guard direct
+                # model use too — the ring blocks cannot express
+                # attention-probability dropout, and silently training
+                # under different regularization than the non-ring path
+                # would skew any loss-parity comparison.
+                raise ValueError(
+                    "attention_impl='ring' does not support attention-prob "
+                    "dropout; set dropout=0 or use attention_impl='xla'")
             y = ring_attention_sharded(q, k, v, mesh=mesh,
-                                       layout=cfg.ring_layout)
+                                       layout=cfg.ring_layout,
+                                       block_impl=cfg.ring_block_impl)
         else:
             attn_rng = None
             if cfg.dropout > 0.0 and not deterministic:
@@ -151,7 +161,25 @@ class GPT(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(2,))
+            # 'save_attention': save each block's attention output + the
+            # flash kernel's logsumexp residual (tagged with
+            # checkpoint_name inside ops/attention.py) so the backward
+            # never re-runs the O(T^2) forward kernel — a remat region
+            # discards custom_vjp residuals, so without the tags the
+            # flash forward would execute twice in the backward. The
+            # saved bytes are O(B*T*C) per block; everything else (qkv
+            # dense, MLP) recomputes cheaply. 'full' is the classic
+            # save-nothing trade.
+            if cfg.remat_policy == "save_attention":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "attn_lse")
+            elif cfg.remat_policy == "full":
+                policy = None
+            else:
+                raise ValueError(
+                    f"unknown remat_policy: {cfg.remat_policy!r} "
+                    "(expected 'save_attention' or 'full')")
+            block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         for i in range(cfg.n_layer):
             x = block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic)
 

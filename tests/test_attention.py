@@ -111,3 +111,62 @@ def test_flash_matches_xla_non_divisor_T(T):
     out = flash_attention(q, k, v, True, None, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# -- flash_attention_lse: the ring's block primitive ----------------------
+
+def _lse_reference(q, k, v, causal=True):
+    """(out, lse) via plain XLA ops."""
+    sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * sm_scale,
+                   k.astype(jnp.float32))
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                     v.astype(jnp.float32))
+    return out, lse
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_matches_reference(causal):
+    from nanosandbox_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.default_rng(11)
+    mk = lambda: jnp.asarray(rng.normal(size=(1, 2, 256, 32)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    out, lse = flash_attention_lse(q, k, v, causal, None, True)  # interpret
+    ref_out, ref_lse = _lse_reference(q, k, v, causal)
+    assert lse.shape == (1, 2, 256)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_lse_gradients_including_dlse():
+    """A loss that consumes BOTH outputs exercises the dlse fold-in
+    (ds = p * (dp - (drow - dlse))) — exactly what the ring's
+    logsumexp-weighted merge does in its backward."""
+    from nanosandbox_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.default_rng(12)
+    mk = lambda: jnp.asarray(rng.normal(size=(1, 2, 256, 32)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    w = jnp.asarray(rng.normal(size=(1, 2, 256)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, True, None, True)
+        return (out ** 2).sum() + (lse * w).sum()
+
+    def loss_ref(q, k, v):
+        out, lse = _lse_reference(q, k, v, True)
+        return (out ** 2).sum() + (lse * w).sum()
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)

@@ -176,3 +176,57 @@ def test_chunked_loss_all_ignored_is_zero():
     out = chunked_cross_entropy_loss(hidden, emb, targets, chunk_size=4,
                                      compute_dtype="float32")
     assert float(out) == 0.0
+
+
+@pytest.mark.parametrize("policy", ["save_attention", "full"])
+def test_remat_policies_match(model_and_params, policy):
+    """Selective remat changes what's saved, never the math: outputs and
+    gradients agree with the non-remat model."""
+    model, params, cfg = model_and_params
+    rmodel = GPT(tiny(remat=True, remat_policy=policy))
+    x = jnp.zeros((2, 16), jnp.int32) + jnp.arange(16)[None, :] % 5
+    np.testing.assert_allclose(
+        np.asarray(model.apply({"params": params}, x)),
+        np.asarray(rmodel.apply({"params": params}, x)), atol=1e-5)
+
+    def loss(m, p):
+        return (m.apply({"params": p}, x).astype(jnp.float32) ** 2).mean()
+
+    g1 = jax.grad(lambda p: loss(model, p))(params)
+    g2 = jax.grad(lambda p: loss(rmodel, p))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_remat_policy_unknown_raises(model_and_params):
+    _, params, _ = model_and_params
+    bad = GPT(tiny(remat=True, remat_policy="nope"))
+    with pytest.raises(ValueError, match="remat_policy"):
+        bad.apply({"params": params}, jnp.zeros((1, 16), jnp.int32))
+
+
+def test_save_attention_policy_elides_kernel_recompute():
+    """The policy's reason to exist, pinned by counting pallas_calls in
+    the grad jaxpr: a remat region discards custom_vjp residuals, so
+    without the checkpoint_name tags on (o, lse) the flash forward runs
+    TWICE in the backward (4 calls); with them it runs once (3 = fwd +
+    bwd_dq + bwd_dkv), same as no remat."""
+
+    def count_calls(remat, policy):
+        cfg = tiny(block_size=128, attention_impl="pallas_interpret",
+                   remat=remat, remat_policy=policy)
+        model = GPT(cfg)
+        x = jnp.zeros((1, 128), jnp.int32)
+        params = model.init(jax.random.key(0), x)["params"]
+
+        def loss(p):
+            return (model.apply({"params": p}, x)
+                    .astype(jnp.float32) ** 2).mean()
+
+        return str(jax.make_jaxpr(jax.grad(loss))(params)).count(
+            "pallas_call")
+
+    assert count_calls(False, "full") == 3 * tiny().n_layer
+    assert count_calls(True, "full") == 4 * tiny().n_layer
+    assert count_calls(True, "save_attention") == 3 * tiny().n_layer
