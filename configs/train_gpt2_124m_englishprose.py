@@ -1,0 +1,31 @@
+# Sustained production-shape run on REAL data for the round-3 evidence
+# chain (VERDICT r2 "Next round" #1): GPT-2 124M (12L/12H/768d, block
+# 1024) char-level on the committed english_prose corpus, driven through
+# the FULL Trainer.run() loop on the TPU — on-chip eval, Orbax
+# checkpointing, TB/JSONL metrics, one jax.profiler window — with tok/s
+# read from the trainer's own iteration log, not a bare bench loop.
+#
+# Scale note: 3.6M train tokens under a 124M model is ~14 epochs over
+# this run; the point is proving the loop + throughput on hardware, and
+# the recorded val-loss curve shows exactly where memorization sets in.
+out_dir = "runs_r3/gpt2_124m_englishprose"
+dataset = "english_prose_char"
+n_layer = 12
+n_head = 12
+n_embd = 768
+block_size = 1024
+batch_size = 16
+gradient_accumulation_steps = 1
+dropout = 0.0
+max_iters = 3000
+lr_decay_iters = 3000
+warmup_iters = 100
+eval_interval = 500
+eval_iters = 20
+log_interval = 50
+learning_rate = 6e-4
+min_lr = 6e-5
+compute_dtype = "bfloat16"
+attention_impl = "auto"
+loss_chunk_size = 0
+profile_steps = "1000:1003"

@@ -252,17 +252,25 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
 # (T, T)), dP = dO @ V^T, dS = P * (dP - Drow). The causal frontier skips
 # fully-masked blocks, halving the work the XLA-recompute backward did.
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                          dq_ref, *, block_q: int, block_k: int,
-                         sm_scale: float, causal: bool):
+                         sm_scale: float, causal: bool, has_dlse: bool):
     qi = pl.program_id(1)
     q = q_ref[0]                                     # (bq, D) storage dtype
     do = do_ref[0]
-    # Row stats arrive lane-replicated (bq, 128); tiling to (bq, bk) gives
-    # the broadcast the math needs without any Mosaic-illegal row vectors.
-    rep = block_k // LANES
-    lse = jnp.tile(lse_ref[0], (1, rep))             # (bq, bk) f32
-    drow = jnp.tile(drow_ref[0], (1, rep))
+    # The row term Drow = rowsum(dO * O) is computed HERE from the o
+    # block instead of arriving as a precomputed lane-replicated f32
+    # operand: that operand cost an XLA prepass plus ~350 MB/layer/step
+    # of HBM traffic at the 124M bench shape, vs a few VPU ops on data
+    # the kernel touches anyway. (bq, 1) column vectors are fine
+    # in-register; only memory-ref blocks must tile to (8, 128).
+    drow = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                   axis=1, keepdims=True)            # (bq, 1) f32
+    if has_dlse:
+        # lse_ref carries [lse | dlse] stacked on the minor dim; fold the
+        # lse cotangent into the row term (ds = p * (dp - (drow - dlse))).
+        drow = drow - lse_ref[0][:, LANES:LANES + 1]
+    lse = lse_ref[0][:, :1]                          # (bq, 1) f32
     seq_len = k_ref.shape[1]
     num_kb = (lax.div((qi + 1) * block_q + block_k - 1, block_k)
               if causal else seq_len // block_k)
@@ -291,9 +299,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                           dk_ref, dv_ref, *, block_q: int, block_k: int,
-                          sm_scale: float, causal: bool):
+                          sm_scale: float, causal: bool, has_dlse: bool):
     ki = pl.program_id(1)
     k = k_ref[0]                                      # (bk, D)
     v = v_ref[0]
@@ -303,16 +311,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, drow_ref,
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
 
-    rep = block_k // LANES
-
     def body(i, carry):
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = jnp.tile(
-            lse_ref[0, pl.ds(i * block_q, block_q), :], (1, rep))
-        drow = jnp.tile(
-            drow_ref[0, pl.ds(i * block_q, block_q), :], (1, rep))
+        # Drow recomputed in-kernel from o (see _flash_bwd_dq_kernel).
+        drow = jnp.sum(
+            do.astype(jnp.float32)
+            * o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32),
+            axis=1, keepdims=True)                    # (bq, 1) f32
+        stats = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        if has_dlse:
+            drow = drow - stats[:, LANES:LANES + 1]
+        lse = stats[:, :1]                            # (bq, 1) f32
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -355,48 +366,58 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
     dof = _pad_qkv(do, do, do, block_q, block_k, causal)[0]
-    # Row terms; padded rows get zeros (their do rows are zero anyway).
-    drow = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
-    if dlse is not None:
-        drow = drow - dlse.astype(jnp.float32)
-    if pad_T:
-        drow = jnp.pad(drow, [(0, 0), (0, 0), (0, pad_T)])
-    # Lane-replicate to the layout the kernels consume.
-    drowf = jnp.broadcast_to(drow.reshape(B * H, Tp, 1), (B * H, Tp, LANES))
+    of = _pad_qkv(o, o, o, block_q, block_k, causal)[0]
+    # Per-row softmax stats, lane-replicated (the only layout Mosaic can
+    # block on the minor dim). Drow is NOT built here any more — both
+    # kernels recompute it in-register from (do, o), which they read
+    # anyway. When the caller supplies a dlse cotangent
+    # (flash_attention_lse), it rides along stacked after lse on the
+    # minor dim so the kernels keep a single stats operand.
     lsef = jnp.broadcast_to(lse, (B * H, Tp, LANES))
+    has_dlse = dlse is not None
+    if has_dlse:
+        d = dlse.astype(jnp.float32)
+        if pad_T:
+            d = jnp.pad(d, [(0, 0), (0, 0), (0, pad_T)])
+        dlsef = jnp.broadcast_to(d.reshape(B * H, Tp, 1),
+                                 (B * H, Tp, LANES))
+        lsef = jnp.concatenate([lsef, dlsef], axis=-1)
+    W = lsef.shape[-1]  # LANES or 2*LANES
 
     grid_q = (B * H, Tp // block_q)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, sm_scale=sm_scale, causal=causal),
+                          block_k=block_k, sm_scale=sm_scale, causal=causal,
+                          has_dlse=has_dlse),
         grid=grid_q,
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, W), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, drowf)
+    )(qf, kf, vf, of, dof, lsef)
 
     grid_k = (B * H, Tp // block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, sm_scale=sm_scale, causal=causal),
+                          block_k=block_k, sm_scale=sm_scale, causal=causal,
+                          has_dlse=has_dlse),
         grid=grid_k,
         in_specs=[
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tp, LANES), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tp, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, W), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
@@ -409,7 +430,7 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, drowf)
+    )(qf, kf, vf, of, dof, lsef)
 
     unpad = lambda g: g.reshape(B, H, Tp, Dp)[:, :, :T, :D]
     return (unpad(dq).astype(q.dtype), unpad(dk).astype(k.dtype),

@@ -412,6 +412,7 @@ class Trainer:
         if prof_range:
             self.profile_dir = os.path.join(cfg.resolved_log_dir, "profile")
         t0 = time.time()
+        window_start_iter = iter_num - 1  # sync precedes step iter_num
         try:
             while iter_num < cfg.max_iters:
                 if (cfg.eval_interval > 0 and iter_num % cfg.eval_interval == 0
@@ -433,6 +434,14 @@ class Trainer:
                                        "config": cfg.to_dict()})
                     if cfg.eval_only:
                         break
+                    # Eval + checkpoint time is reported on its own lines;
+                    # restart the throughput window so the next logged
+                    # tok/s reflects training steps only. iter_num - 1,
+                    # not iter_num: this sync point is BEFORE step
+                    # iter_num runs, while the log-step sync is after its
+                    # step completes — the next window spans steps
+                    # [iter_num, next_log] inclusive.
+                    t0, window_start_iter = time.time(), iter_num - 1
 
                 if prof_range and iter_num == prof_range[0]:
                     jax.profiler.start_trace(self.profile_dir)
@@ -460,8 +469,18 @@ class Trainer:
                 if cfg.log_interval > 0 and iter_num % cfg.log_interval == 0:
                     loss = float(metrics["loss"])  # sync point
                     last_loss = loss
-                    dt = time.time() - t0
-                    t0 = time.time()
+                    # Window-averaged timing: under async dispatch the
+                    # host enqueues steps far faster than the device runs
+                    # them, and the scalar readback above drains the whole
+                    # backlog — so per-iteration wall time is meaningless
+                    # at the log step (it would charge ~log_interval
+                    # steps of device work to one iteration and understate
+                    # tok/s by that factor). Average over the iterations
+                    # since the last sync point instead.
+                    now = time.time()
+                    n_iters = iter_num - window_start_iter
+                    dt = (now - t0) / max(n_iters, 1)
+                    t0, window_start_iter = now, iter_num
                     toks = tokens_per_iter / max(dt, 1e-9)
                     mfu = flops_per_iter / max(dt, 1e-9) / peak
                     if self.is_main:
@@ -476,8 +495,6 @@ class Trainer:
                         "perf/tokens_per_sec": toks,
                         "perf/mfu": mfu,
                     })
-                else:
-                    t0 = time.time()
                 iter_num += 1
         finally:
             if self._profiling:

