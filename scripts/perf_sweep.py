@@ -110,7 +110,11 @@ def main(argv: list[str]) -> list[dict]:
     elif mode == "longcontext":
         # Round-2 VERDICT weak #1 follow-through: a measured long-context
         # number on this hardware (single chip -> plain flash at T=8192;
-        # the ring carries the same kernel across chips).
+        # the ring carries the same kernel across chips). The block-1024
+        # default batch list would mostly OOM at 8192 tokens/sequence, so
+        # this mode has its own default; --batch_sizes still overrides.
+        if "batch_sizes" not in kv:
+            batches = [1, 2]
         for bs in batches:
             for remat, policy in [(False, "save_attention"),
                                   (True, "save_attention"), (True, "full")]:

@@ -149,3 +149,35 @@ def test_remat_save_attention_compiles_on_tpu():
 
     g = jax.jit(jax.grad(loss))(params)
     assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+
+
+def test_flash_lse_gradients_compile_with_dlse_on_tpu():
+    """The has_dlse backward is its own Mosaic program (W=2*LANES stacked
+    stats operand, lane-offset column reads) — compile and check it on
+    real hardware, not just interpret mode. A loss consuming BOTH outputs
+    forces a nonzero dlse cotangent through the kernels."""
+    from nanosandbox_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.default_rng(6)
+    q, k, v = rand_qkv(rng, B=1, H=2, T=1024, D=64, dtype=jnp.float32)
+    w = jnp.asarray(rng.normal(size=(1, 2, 1024)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, True, None, False)
+        return (out.astype(jnp.float32) ** 2).sum() + (lse * w).sum()
+
+    def loss_ref(q, k, v):
+        sm = 64 ** -0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * sm, k)
+        T = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return (out ** 2).sum() + (lse * w).sum()
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gf, gr):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(np.abs(b32).max(), 1e-8)
+        assert np.abs(a32 - b32).max() / scale < 1e-2
