@@ -8,14 +8,14 @@ results stream to stdout as JSON lines and are summarized at the end.
 Usage:
     python scripts/perf_sweep.py [--out=sweep.json] [--iters=10]
         [--impls=pallas,xla] [--batch_sizes=8,16,32,64] [--full]
-        [--mode=remat|longcontext]
+        [--mode=remat|longcontext|scale]
 
 Default sweeps impl x batch at remat=False/chunk=128, then re-measures the
 winner with remat on/off and chunked vs full loss. --full crosses
 everything (slow). --mode presets replace the grid (and take precedence
 over --full): 'remat' compares no-remat vs remat_policy
 save_attention/full per batch size; 'longcontext' measures block 8192
-with chunked loss.
+with chunked loss; 'scale' measures 350M/760M single-chip points.
 """
 
 from __future__ import annotations
@@ -94,9 +94,9 @@ def main(argv: list[str]) -> list[dict]:
     if mode and full:
         print(json.dumps({"warning": "--full is ignored when --mode is "
                                      "given"}), flush=True)
-    if mode and mode not in ("remat", "longcontext"):
+    if mode and mode not in ("remat", "longcontext", "scale"):
         raise SystemExit(f"unknown --mode={mode} "
-                         "(expected 'remat' or 'longcontext')")
+                         "(expected 'remat', 'longcontext', or 'scale')")
     if mode == "remat":
         # Round-2 VERDICT weak #2: remat was 35.5% MFU vs 43% without.
         # Compare the selective policy (saves flash residuals, backward
@@ -107,6 +107,18 @@ def main(argv: list[str]) -> list[dict]:
             for policy in ("save_attention", "full"):
                 run_point(attention_impl="pallas", batch_size=bs,
                           remat=True, remat_policy=policy)
+    elif mode == "scale":
+        # Model-size scaling on ONE chip: bigger matmuls feed the MXU
+        # better (124M ~39-43% MFU by chip conditions; 350M ~47%; 760M
+        # fits in 16 GB HBM only with remat). batch_size here is pinned
+        # per point — the known-good HBM fit, not the CLI list.
+        run_point(n_layer=24, n_head=16, n_embd=1024, batch_size=8,
+                  attention_impl="pallas", remat=False)          # 350M
+        run_point(n_layer=24, n_head=16, n_embd=1024, batch_size=16,
+                  attention_impl="pallas", remat=True)
+        run_point(n_layer=36, n_head=20, n_embd=1280, batch_size=8,
+                  attention_impl="pallas", remat=True,
+                  loss_chunk_size=512)                           # 760M
     elif mode == "longcontext":
         # Round-2 VERDICT weak #1 follow-through: a measured long-context
         # number on this hardware (single chip -> plain flash at T=8192;

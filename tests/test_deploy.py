@@ -27,6 +27,7 @@ MANIFESTS = [
     "storage/12-filestore-rwx.yaml",
     "jobs/20-download-tiny-shakespeare.yaml",
     "jobs/21-download-openwebtext.yaml",
+    "jobs/22-prepare-english-prose.yaml",
     "jobs/30-train-singlepod.yaml",
     "services/41-train-mp-headless.yaml",
     "statefulset/40-train-multipod.yaml",
@@ -67,6 +68,7 @@ def _pod_spec(doc):
 def test_jobs_mount_pvc_and_proxy():
     for rel in ("jobs/20-download-tiny-shakespeare.yaml",
                 "jobs/21-download-openwebtext.yaml",
+                "jobs/22-prepare-english-prose.yaml",
                 "jobs/30-train-singlepod.yaml"):
         doc = load(rel)[0]
         spec = _pod_spec(doc)
@@ -74,8 +76,14 @@ def test_jobs_mount_pvc_and_proxy():
         assert vols["data"]["persistentVolumeClaim"]["claimName"] == \
             "disttrain-pvc", rel
         c = spec["containers"][0]
-        assert {"name": "proxy-config"} in [
-            e["configMapRef"] for e in c["envFrom"]], rel
+        refs = [e["configMapRef"] for e in c["envFrom"]]
+        assert any(r["name"] == "proxy-config" for r in refs), rel
+        # The zero-egress english-prose Job must NOT hard-require the
+        # proxy ConfigMap (air-gapped clusters skip 01-proxy-config.yaml);
+        # the downloading jobs must (a silent missing proxy would just
+        # hang the download).
+        optional = any(r.get("optional") for r in refs)
+        assert optional == ("english-prose" in rel), rel
         assert any(m["mountPath"] == "/data" for m in c["volumeMounts"]), rel
 
 
@@ -254,8 +262,18 @@ def test_dataset_jobs_feed_the_train_jobs_data_dir():
                     train_dirs.add(a.split("=", 1)[1])
     assert train_dirs == {"/data/datasets"}
     for rel in ("jobs/20-download-tiny-shakespeare.yaml",
-                "jobs/21-download-openwebtext.yaml"):
+                "jobs/21-download-openwebtext.yaml",
+                "jobs/22-prepare-english-prose.yaml"):
         spec = docs[rel][0]["spec"]["template"]["spec"]
         text = str(spec)
         assert "/data/datasets" in text, (
             f"{rel}: does not write under /data/datasets")
+
+
+def test_image_ships_the_offline_corpus_fixture():
+    """jobs/22 runs english_prose_char prep with zero egress, which only
+    works if the Dockerfile copies the committed fixture to the path
+    prepare.py resolves (package root /app -> /app/data/fixtures)."""
+    with open(os.path.join(REPO, "docker", "Dockerfile")) as f:
+        dockerfile = f.read()
+    assert "COPY data/fixtures/ /app/data/fixtures/" in dockerfile
