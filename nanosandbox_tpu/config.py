@@ -35,7 +35,10 @@ class TrainConfig:
     eval_iters: int = 200
     eval_only: bool = False
     always_save_checkpoint: bool = True
-    init_from: str = "scratch"  # 'scratch' | 'resume' | 'auto' (resume if ckpt exists)
+    # 'scratch' | 'resume' | 'auto' (resume if ckpt exists) | 'gpt2' /
+    # 'gpt2-medium' / 'gpt2-large' / 'gpt2-xl' (pretrained HF weights, the
+    # reference's fine-tune path) | 'hf:<path>' (local save_pretrained dir)
+    init_from: str = "scratch"
     keep_checkpoints: int = 3
 
     # -- model (reference ipynb:74-76: n_layer/n_head/n_embd/block_size/dropout) --

@@ -1,0 +1,111 @@
+"""Pretrained GPT-2 weight import: HF transformers -> this model's pytree.
+
+The reference's training core supports `--init_from=gpt2*` (nanoGPT loads
+the HF GPT-2 family and fine-tunes); this is the TPU-native counterpart.
+The mapping is mechanical because the model was built name-compatible:
+
+    transformer.wte.weight            -> wte.embedding   (tied lm_head)
+    transformer.wpe.weight            -> wpe.embedding
+    transformer.h.{i}.ln_1.weight     -> h_{i}.ln_1.scale     (+ bias)
+    transformer.h.{i}.attn.c_attn.*   -> h_{i}.attn.c_attn.*  ([q|k|v] packed)
+    transformer.h.{i}.attn.c_proj.*   -> h_{i}.attn.c_proj.*
+    transformer.h.{i}.ln_2.weight     -> h_{i}.ln_2.scale
+    transformer.h.{i}.mlp.c_fc.*      -> h_{i}.mlp.c_fc.*
+    transformer.h.{i}.mlp.c_proj.*    -> h_{i}.mlp.c_proj.*
+    transformer.ln_f.weight           -> ln_f.scale
+
+No transposes anywhere: HF GPT-2 uses Conv1D with (in, out) weights, the
+same orientation as flax Dense kernels (nanoGPT needed transposes only
+because torch.nn.Linear stores (out, in)). Numerics that must line up and
+do: gelu tanh-approx, LayerNorm eps 1e-5, [q|k|v] packing order, tied head.
+
+Offline note: this environment cannot download pretrained weights; the
+conversion is exercised against randomly initialized HF models saved
+locally (tests/test_convert.py), and `init_from=hf:<path>` consumes any
+local save_pretrained directory.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+HF_GPT2_NAMES = ("gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl")
+
+
+def gpt_config_from_hf(hf_config, *, compute_dtype: str = "bfloat16",
+                       dropout: float = 0.0):
+    """Our GPTConfig mirroring an HF GPT2Config (bias is always True in
+    the pretrained family)."""
+    from nanosandbox_tpu.config import GPTConfig
+
+    return GPTConfig(
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        n_embd=hf_config.n_embd,
+        block_size=hf_config.n_positions,
+        vocab_size=hf_config.vocab_size,
+        dropout=dropout,
+        bias=True,
+        compute_dtype=compute_dtype,
+    )
+
+
+def params_from_hf_state_dict(state_dict: dict, n_layer: int) -> dict:
+    """Convert an HF GPT2LMHeadModel state_dict to this model's pytree
+    (numpy float32 leaves; callers device_put with their shardings)."""
+    sd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
+                        else v, np.float32)
+          for k, v in state_dict.items()}
+
+    def take(name):
+        return sd[f"transformer.{name}"]
+
+    params: dict[str, Any] = {
+        "wte": {"embedding": take("wte.weight")},
+        "wpe": {"embedding": take("wpe.weight")},
+        "ln_f": {"scale": take("ln_f.weight"), "bias": take("ln_f.bias")},
+    }
+    for i in range(n_layer):
+        h = f"h.{i}"
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": take(f"{h}.ln_1.weight"),
+                     "bias": take(f"{h}.ln_1.bias")},
+            "ln_2": {"scale": take(f"{h}.ln_2.weight"),
+                     "bias": take(f"{h}.ln_2.bias")},
+            "attn": {
+                "c_attn": {"kernel": take(f"{h}.attn.c_attn.weight"),
+                           "bias": take(f"{h}.attn.c_attn.bias")},
+                "c_proj": {"kernel": take(f"{h}.attn.c_proj.weight"),
+                           "bias": take(f"{h}.attn.c_proj.bias")},
+            },
+            "mlp": {
+                "c_fc": {"kernel": take(f"{h}.mlp.c_fc.weight"),
+                         "bias": take(f"{h}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": take(f"{h}.mlp.c_proj.weight"),
+                           "bias": take(f"{h}.mlp.c_proj.bias")},
+            },
+        }
+    return params
+
+
+def load_hf_gpt2(name_or_path: str):
+    """(GPTConfig, params pytree) from an HF model name or local
+    save_pretrained directory. Import of torch/transformers is deferred:
+    both are CPU-only conversion dependencies, never on the train path."""
+    from transformers import GPT2LMHeadModel
+
+    model = GPT2LMHeadModel.from_pretrained(name_or_path)
+    cfg = gpt_config_from_hf(model.config)
+    params = params_from_hf_state_dict(model.state_dict(), cfg.n_layer)
+    return cfg, params
+
+
+def resolve_init_from(init_from: str) -> str | None:
+    """'gpt2*' -> HF hub name; 'hf:<path>' -> local path; else None."""
+    if init_from in HF_GPT2_NAMES:
+        return init_from
+    if init_from.startswith("hf:"):
+        return init_from[3:]
+    return None

@@ -121,7 +121,11 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
         cfg = self.cfg
+        # epsilon=1e-5 matches torch.nn.LayerNorm (nanoGPT/HF GPT-2), not
+        # flax's 1e-6 default — required for faithful pretrained-weight
+        # import (models/convert.py).
         ln = lambda name: nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
+                                       epsilon=1e-5,
                                        param_dtype=cfg.param_dtype, name=name)
         x = x + CausalSelfAttention(cfg, mesh=self.mesh, name="attn")(
             ln("ln_1")(x).astype(cfg.compute_dtype), deterministic)
@@ -183,7 +187,7 @@ class GPT(nn.Module):
         for i in range(cfg.n_layer):
             x = block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic)
 
-        x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
+        x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32, epsilon=1e-5,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
         if return_hidden:
             return x

@@ -111,6 +111,35 @@ class Trainer:
         self.process_count = jax.process_count()
         self.is_main = self.process_index == 0
 
+        # Pretrained import (reference `--init_from=gpt2*`): the HF config
+        # dictates the architecture, exactly as nanoGPT forces its model
+        # args from the loaded checkpoint. block_size may be CROPPED
+        # below the pretrained context (wpe rows sliced); growing it has
+        # no trained positions to use and errors.
+        from nanosandbox_tpu.models.convert import resolve_init_from
+        hf_src = resolve_init_from(cfg.init_from)
+        self._hf_params = None
+        self._pretrained = hf_src is not None
+        if hf_src:
+            from nanosandbox_tpu.models.convert import load_hf_gpt2
+            hf_cfg, hf_params = load_hf_gpt2(hf_src)
+            if cfg.block_size > hf_cfg.block_size:
+                raise ValueError(
+                    f"block_size {cfg.block_size} exceeds the pretrained "
+                    f"context {hf_cfg.block_size} ({cfg.init_from})")
+            if cfg.block_size < hf_cfg.block_size:
+                hf_params["wpe"]["embedding"] = \
+                    hf_params["wpe"]["embedding"][:cfg.block_size]
+            self.cfg = cfg = cfg.replace(
+                n_layer=hf_cfg.n_layer, n_head=hf_cfg.n_head,
+                n_embd=hf_cfg.n_embd, vocab_size=hf_cfg.vocab_size,
+                bias=True)
+            self._hf_params = hf_params
+            if self.is_main:
+                print(f"initializing from pretrained {cfg.init_from}: "
+                      f"{hf_cfg.n_layer}L/{hf_cfg.n_head}H/"
+                      f"{hf_cfg.n_embd}d, vocab {hf_cfg.vocab_size}")
+
         self.dataset = BinDataset(cfg.data_dir, cfg.dataset)
         vocab = cfg.vocab_size or self.dataset.vocab_size
         self.model_cfg = GPTConfig.from_train_config(cfg, vocab)
@@ -207,6 +236,34 @@ class Trainer:
         init = jax.jit(self._init_state,
                        out_shardings=self.state_shardings)
         return init(jax.random.key(self.cfg.seed))
+
+    def pretrained_state(self) -> dict[str, Any]:
+        """Training state from the imported HF weights: each converted
+        leaf is placed with its mesh sharding (so FSDP fine-tuning of a
+        pretrained model shards on arrival), fresh optimizer state.
+
+        Single-shot: the host-side float32 copy is released once placed
+        (gpt2-xl is ~6 GB of numpy that must not stay pinned for the whole
+        run), so a second call raises instead of silently re-initializing.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._hf_params is None:
+            raise RuntimeError(
+                "pretrained weights already consumed (pretrained_state is "
+                "single-shot) or init_from is not a pretrained source")
+        dtype = jnp.dtype(self.cfg.param_dtype)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, dtype), s),
+            self._hf_params, self.state_shardings["params"])
+        opt_state = jax.jit(
+            self.tx.init,
+            out_shardings=self.state_shardings["opt_state"])(params)
+        step = jax.device_put(jnp.zeros((), jnp.int32),
+                              self.state_shardings["step"])
+        self._hf_params = None
+        return {"params": params, "opt_state": opt_state, "step": step}
 
     # -- compiled steps ------------------------------------------------------
 
@@ -320,20 +377,27 @@ class Trainer:
     # -- evaluation (nanoGPT estimate_loss) ----------------------------------
 
     def estimate_loss(self, state, eval_iters: int | None = None) -> dict:
+        import jax.numpy as jnp
+
         eval_iters = eval_iters or self.cfg.eval_iters
         _, eval_step = self.compiled_steps()
         out = {}
         for split in ("train", "val"):
-            losses = np.zeros(eval_iters)
+            # Enqueue every eval step, then read ONE scalar: under async
+            # dispatch each float() is a host<->device round trip (~100ms+
+            # on a tunneled PJRT transport), so a per-step readback would
+            # cost eval_iters RTTs per split — the char-convergence run
+            # spent ~40% of its wall clock there before this change.
+            losses = []
             for i in range(eval_iters):
                 xb, yb = self.dataset.sample_batch(
                     split, 1_000_000 + i,
                     self.cfg.batch_size // self.process_count,
                     self.cfg.block_size, seed=self.cfg.seed + 1,
                     process_index=self.process_index)
-                losses[i] = float(eval_step(state, self.to_global(xb),
-                                            self.to_global(yb)))
-            out[split] = float(losses.mean())
+                losses.append(eval_step(state, self.to_global(xb),
+                                        self.to_global(yb)))
+            out[split] = float(jnp.stack(losses).mean())
         return out
 
     # -- MFU -----------------------------------------------------------------
@@ -389,6 +453,8 @@ class Trainer:
             if self.is_main:
                 print(f"resumed from iter {iter_num} "
                       f"(best val loss {best_val_loss:.4f})")
+        elif self._pretrained:
+            state = self.pretrained_state()  # raises if already consumed
         else:
             state = self.init_state()
 
