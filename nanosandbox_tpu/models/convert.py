@@ -40,6 +40,20 @@ def gpt_config_from_hf(hf_config, *, compute_dtype: str = "bfloat16",
     the pretrained family)."""
     from nanosandbox_tpu.config import GPTConfig
 
+    # The flax model hard-codes two numerics the GPT-2 family uses:
+    # tanh-approx gelu and LayerNorm eps 1e-5. hf: paths accept arbitrary
+    # GPT2Configs, so a variant model must fail here, not convert into
+    # silently-wrong forward passes.
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"unsupported activation_function {act!r}: this model "
+            "implements GPT-2's tanh-approx gelu ('gelu_new') only")
+    eps = float(getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if abs(eps - 1e-5) > 1e-7:
+        raise ValueError(
+            f"unsupported layer_norm_epsilon {eps}: this model hard-codes "
+            "torch's 1e-5 (models/gpt.py _layer_norm)")
     return GPTConfig(
         n_layer=hf_config.n_layer,
         n_head=hf_config.n_head,
@@ -55,12 +69,15 @@ def gpt_config_from_hf(hf_config, *, compute_dtype: str = "bfloat16",
 def params_from_hf_state_dict(state_dict: dict, n_layer: int) -> dict:
     """Convert an HF GPT2LMHeadModel state_dict to this model's pytree
     (numpy float32 leaves; callers device_put with their shardings)."""
-    sd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
-                        else v, np.float32)
-          for k, v in state_dict.items()}
 
     def take(name):
-        return sd[f"transformer.{name}"]
+        # Convert lazily, per referenced tensor: the state_dict also holds
+        # entries this mapping never reads (the weight-tied lm_head.weight
+        # duplicate — ~322 MB fp32 for gpt2-xl — and, on some transformers
+        # versions, per-layer causal-mask buffers).
+        v = state_dict[f"transformer.{name}"]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
+                          else v, np.float32)
 
     params: dict[str, Any] = {
         "wte": {"embedding": take("wte.weight")},

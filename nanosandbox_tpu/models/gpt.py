@@ -30,6 +30,14 @@ def _dense_init(std: float = 0.02):
     return nn.initializers.normal(stddev=std)
 
 
+def _layer_norm(cfg: GPTConfig, name: str) -> nn.LayerNorm:
+    """LayerNorm in f32 with epsilon=1e-5 — torch.nn.LayerNorm's default
+    (nanoGPT/HF GPT-2), not flax's 1e-6; pretrained-weight import
+    (models/convert.py) relies on the match."""
+    return nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32, epsilon=1e-5,
+                        param_dtype=cfg.param_dtype, name=name)
+
+
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     mesh: Any = None  # required for attention_impl='ring' (sequence parallel)
@@ -121,16 +129,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
         cfg = self.cfg
-        # epsilon=1e-5 matches torch.nn.LayerNorm (nanoGPT/HF GPT-2), not
-        # flax's 1e-6 default — required for faithful pretrained-weight
-        # import (models/convert.py).
-        ln = lambda name: nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32,
-                                       epsilon=1e-5,
-                                       param_dtype=cfg.param_dtype, name=name)
         x = x + CausalSelfAttention(cfg, mesh=self.mesh, name="attn")(
-            ln("ln_1")(x).astype(cfg.compute_dtype), deterministic)
+            _layer_norm(cfg, "ln_1")(x).astype(cfg.compute_dtype),
+            deterministic)
         x = x + MLP(cfg, name="mlp")(
-            ln("ln_2")(x).astype(cfg.compute_dtype), deterministic)
+            _layer_norm(cfg, "ln_2")(x).astype(cfg.compute_dtype),
+            deterministic)
         return x
 
 
@@ -187,8 +191,7 @@ class GPT(nn.Module):
         for i in range(cfg.n_layer):
             x = block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic)
 
-        x = nn.LayerNorm(use_bias=cfg.bias, dtype=jnp.float32, epsilon=1e-5,
-                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        x = _layer_norm(cfg, "ln_f")(x)
         if return_hidden:
             return x
         # Weight-tied LM head (nanoGPT ties lm_head.weight = wte.weight).

@@ -119,7 +119,7 @@ class Trainer:
         from nanosandbox_tpu.models.convert import resolve_init_from
         hf_src = resolve_init_from(cfg.init_from)
         self._hf_params = None
-        self._pretrained = hf_src is not None
+        self._pretrained = bool(hf_src)  # 'hf:' (empty path) is not one
         if hf_src:
             from nanosandbox_tpu.models.convert import load_hf_gpt2
             hf_cfg, hf_params = load_hf_gpt2(hf_src)
@@ -254,8 +254,12 @@ class Trainer:
                 "pretrained weights already consumed (pretrained_state is "
                 "single-shot) or init_from is not a pretrained source")
         dtype = jnp.dtype(self.cfg.param_dtype)
+        # Cast on host, then ONE placement directly onto the sharding:
+        # jnp.asarray would first copy to the default device and the
+        # device_put would then reshard device-to-device — double
+        # transfer plus a transient full replica for a gpt2-xl import.
         params = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x, dtype), s),
+            lambda x, s: jax.device_put(np.asarray(x, dtype), s),
             self._hf_params, self.state_shardings["params"])
         opt_state = jax.jit(
             self.tx.init,

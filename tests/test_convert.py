@@ -117,3 +117,33 @@ def test_block_size_growth_rejected(char_dataset, tmp_path):
                       device="cpu", tensorboard=False)
     with pytest.raises(ValueError, match="pretrained context"):
         Trainer(cfg)
+
+
+def test_variant_configs_rejected():
+    """hf: paths accept arbitrary GPT2Configs — numerics this model does
+    not implement must fail at conversion, not corrupt the forward."""
+    from transformers import GPT2Config
+
+    exact_gelu = GPT2Config(n_layer=1, n_head=1, n_embd=32,
+                            activation_function="gelu")
+    with pytest.raises(ValueError, match="gelu_new"):
+        gpt_config_from_hf(exact_gelu)
+    odd_eps = GPT2Config(n_layer=1, n_head=1, n_embd=32,
+                         layer_norm_epsilon=1e-6)
+    with pytest.raises(ValueError, match="layer_norm_epsilon"):
+        gpt_config_from_hf(odd_eps)
+
+
+def test_empty_hf_path_is_not_pretrained(char_dataset, tmp_path):
+    """init_from='hf:' (malformed empty path) must not half-enter the
+    pretrained flow."""
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = TrainConfig(data_dir=char_dataset, dataset="shakespeare_char",
+                      out_dir=str(tmp_path / "out"), init_from="hf:",
+                      n_layer=1, n_head=2, n_embd=32, block_size=16,
+                      batch_size=8, device="cpu", tensorboard=False)
+    trainer = Trainer(cfg)
+    assert trainer._pretrained is False
+    assert trainer.model_cfg.n_layer == 1  # user dims kept
