@@ -1,0 +1,31 @@
+# Fine-tune pretrained GPT-2 124M on an OpenWebText subset (nanoGPT's
+# finetune config counterpart). Requires the HF weights: pass
+# --init_from=gpt2 on a networked machine, or point
+# --init_from=hf:/data/models/gpt2 at a local save_pretrained directory
+# (e.g. pre-staged on the PVC for air-gapped clusters).
+#
+# The dataset must be GPT-2-BPE tokenized (python -m
+# nanosandbox_tpu.data.prepare openwebtext — or prepare_bpe_dataset on
+# any text, including the committed english_prose fixture, when tiktoken
+# can fetch its vocab; char-level ids are NOT BPE-compatible).
+out_dir = "out/finetune_gpt2"
+dataset = "openwebtext"
+init_from = "gpt2"  # adopts 12L/12H/768d, vocab 50257, bias=True
+
+# fine-tune schedule: short, low LR, no warmup restart (nanoGPT's
+# finetune_shakespeare recipe shape)
+max_iters = 2000
+lr_decay_iters = 2000
+warmup_iters = 0
+learning_rate = 3e-5
+min_lr = 3e-6
+decay_lr = False
+
+block_size = 1024
+batch_size = 8
+gradient_accumulation_steps = 4
+dropout = 0.1          # regularize when fine-tuning on small corpora
+eval_interval = 200
+eval_iters = 40
+log_interval = 10
+compute_dtype = "bfloat16"

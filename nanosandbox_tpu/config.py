@@ -211,6 +211,18 @@ def load_config(argv: list[str] | None = None,
         ns: dict[str, Any] = dict(cfg.to_dict())
         with open(path, "r", encoding="utf-8") as f:
             exec(compile(f.read(), path, "exec"), ns)
+        # Strictness must cover FILE bindings too, or a typo'd key in a
+        # config ('learning_rte = ...') silently trains with the default.
+        # Underscore-prefixed names are deliberate locals; modules (from
+        # imports) and callables (helpers) are allowed scaffolding.
+        import types
+        for k, v in ns.items():
+            if (k in _FIELD_TYPES or k.startswith("_")
+                    or isinstance(v, types.ModuleType) or callable(v)):
+                continue
+            raise ValueError(
+                f"unknown config key {k!r} in {path} (prefix helper "
+                "variables with '_' to keep them)")
         for k in _FIELD_TYPES:
             if k in ns and ns[k] != getattr(cfg, k):
                 overrides[k] = ns[k]

@@ -62,3 +62,32 @@ def test_gpt_config_from_train_config():
     cfg = TrainConfig(n_layer=3, n_head=3, n_embd=48)
     g = GPTConfig.from_train_config(cfg, vocab_size=65)
     assert (g.n_layer, g.vocab_size) == (3, 65)
+
+
+def test_every_shipped_config_parses():
+    """load_config on every configs/*.py: every shipped config must
+    exec cleanly under the strict file-binding check (a typo'd key in a
+    config file raises at load, not silently trains with defaults)."""
+    import glob
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "configs", "*.py")))
+    assert len(paths) >= 9
+    for p in paths:
+        cfg = load_config([p])
+        assert cfg.n_layer >= 1, p
+        assert cfg.batch_size >= 1, p
+
+
+def test_config_file_typo_key_raises(tmp_path):
+    """File bindings get the same strictness as --key=value flags: a
+    typo'd key must raise, not silently fall back to the default."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("learning_rte = 3e-5\n")
+    with pytest.raises(ValueError, match="learning_rte"):
+        load_config([str(bad)])
+    ok = tmp_path / "ok.py"
+    ok.write_text("import math\n_helper = 2\nlearning_rate = math.e * 1e-4\n")
+    cfg = load_config([str(ok)])
+    assert abs(cfg.learning_rate - 2.718e-4) < 1e-6
