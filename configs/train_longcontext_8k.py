@@ -4,10 +4,13 @@
 # the config that exercises ops/ring_attention.py at scale.
 #
 # Sized for a 4-chip host (mesh 1x1x4x1); no-hardware sanity run on 8
-# virtual devices needs --mesh_dp=2:
+# virtual devices needs --mesh_dp=2 plus scale-down flags (the full 12L
+# model at 50304 vocab takes tens of CPU-minutes per step):
 #   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 #     python -m nanosandbox_tpu.train configs/train_longcontext_8k.py \
-#       --device=cpu --mesh_dp=2 --max_iters=2
+#       --device=cpu --mesh_dp=2 --max_iters=2 --block_size=2048 \
+#       --batch_size=4 --gradient_accumulation_steps=1 \
+#       --n_layer=2 --n_embd=128 --n_head=2 --remat=False
 out_dir = "out/longcontext_8k"
 dataset = "openwebtext"
 vocab_size = 50304
@@ -22,6 +25,9 @@ mesh_dp = 1
 mesh_sp = 4          # sequence sharded 4-way; K/V rings over ICI
 attention_impl = "ring"
 remat = True         # 8k activations are HBM-hungry; trade FLOPs for memory
+# Chunked head+loss runs per-shard inside shard_map under sp (full
+# logits at 8k x 50304 would be 1.6 GB f32 per sequence).
+loss_chunk_size = 512
 
 batch_size = 4
 gradient_accumulation_steps = 8

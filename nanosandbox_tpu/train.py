@@ -272,21 +272,27 @@ class Trainer:
     # -- compiled steps ------------------------------------------------------
 
     def _loss_fn(self, params, x, y, rng):
-        from nanosandbox_tpu.models.gpt import (chunked_cross_entropy_loss,
-                                                cross_entropy_loss)
+        from nanosandbox_tpu.models.gpt import (
+            chunked_cross_entropy_loss, cross_entropy_loss,
+            sharded_chunked_cross_entropy_loss)
 
         deterministic = self.cfg.dropout == 0.0 or rng is None
         kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
-        # Chunked head+loss keeps (B, T, vocab) logits out of HBM — but a
-        # scan over a seq-sharded T dim would force gathers, so sequence
-        # parallelism uses the plain path (its per-shard logits are 1/sp
-        # the size anyway).
-        if self.cfg.loss_chunk_size > 0 and self.mesh.shape["seq"] == 1:
+        # Chunked head+loss keeps (B, T, vocab) logits out of HBM. Under
+        # sequence parallelism the scan runs per-shard inside shard_map
+        # (a scan over the T-sharded dim would otherwise force gathers,
+        # and full logits at long context defeat the ring's memory story).
+        if self.cfg.loss_chunk_size > 0:
             hidden = self.model.apply({"params": params}, x,
                                       deterministic=deterministic,
                                       return_hidden=True, **kwargs)
-            return chunked_cross_entropy_loss(
-                hidden, params["wte"]["embedding"], y,
+            if self.mesh.shape["seq"] == 1:
+                return chunked_cross_entropy_loss(
+                    hidden, params["wte"]["embedding"], y,
+                    chunk_size=self.cfg.loss_chunk_size,
+                    compute_dtype=self.cfg.compute_dtype)
+            return sharded_chunked_cross_entropy_loss(
+                hidden, params["wte"]["embedding"], y, mesh=self.mesh,
                 chunk_size=self.cfg.loss_chunk_size,
                 compute_dtype=self.cfg.compute_dtype)
         logits = self.model.apply({"params": params}, x,
