@@ -102,20 +102,31 @@ def main(argv: list[str]) -> list[dict]:
         # Compare the selective policy (saves flash residuals, backward
         # never re-runs the forward kernel) against classic full remat
         # and the no-remat ceiling, at the remat configs' batch size.
+        # loss_chunk_size pinned to 0 (full logits): the TrainConfig
+        # default of 128 would silently put these points on the chunked
+        # path, ~10% off the full-logits numbers bench.py reports.
         for bs in batches:
-            run_point(attention_impl="pallas", batch_size=bs, remat=False)
+            run_point(attention_impl="pallas", batch_size=bs, remat=False,
+                      loss_chunk_size=0)
             for policy in ("save_attention", "full"):
                 run_point(attention_impl="pallas", batch_size=bs,
-                          remat=True, remat_policy=policy)
+                          remat=True, remat_policy=policy,
+                          loss_chunk_size=0)
     elif mode == "scale":
         # Model-size scaling on ONE chip: bigger matmuls feed the MXU
         # better (124M ~39-43% MFU by chip conditions; 350M ~47%; 760M
         # fits in 16 GB HBM only with remat). batch_size here is pinned
         # per point — the known-good HBM fit, not the CLI list.
+        # 350M batch 8: full logits for the MFU-ceiling number; the
+        # batch-16 remat point pins the chunked loss at 512 (full logits
+        # there are 3.3 GB and the lingering allocation makes the NEXT
+        # point spill — memory economy is the whole reason to remat).
         run_point(n_layer=24, n_head=16, n_embd=1024, batch_size=8,
-                  attention_impl="pallas", remat=False)          # 350M
+                  attention_impl="pallas", remat=False,
+                  loss_chunk_size=0)                             # 350M
         run_point(n_layer=24, n_head=16, n_embd=1024, batch_size=16,
-                  attention_impl="pallas", remat=True)
+                  attention_impl="pallas", remat=True,
+                  loss_chunk_size=512)
         run_point(n_layer=36, n_head=20, n_embd=1280, batch_size=8,
                   attention_impl="pallas", remat=True,
                   loss_chunk_size=512)                           # 760M
