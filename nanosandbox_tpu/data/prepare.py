@@ -105,14 +105,30 @@ def prepare_char_dataset(out_dir: str, source_file: str | None = None,
     return write_bins(ids, out_dir, tok.meta())
 
 
-# Resolved relative to the repo checkout (this file lives at
-# <repo>/nanosandbox_tpu/data/prepare.py), not the CWD, so the
-# english_prose_char prep works from any working directory — e.g. the
-# k8s dataset Job runs it with the PVC as CWD.
-_REPO_ROOT = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
+# Resolved relative to the repo checkout (shared with tokenizer.py), not
+# the CWD, so the fixture preps work from any working directory — e.g.
+# the k8s dataset Job runs with the PVC as CWD.
+from nanosandbox_tpu.data.tokenizer import _REPO_ROOT  # noqa: E402
+
 REAL_FIXTURE = os.path.join(_REPO_ROOT, "data", "fixtures",
                             "english_prose.txt")
+
+
+def _prepare_fixture_dataset(out_dir: str, fixture: str, build_hint: str,
+                             make_tokenizer, source_file: str | None) -> dict:
+    """Shared prep for the committed real-text fixtures: resolve the
+    source (explicit file > fixture), fail loudly with the build command
+    when absent (no synthetic fallback — real data or a loud failure),
+    tokenize, write bins."""
+    src = source_file or fixture
+    if not os.path.exists(src):
+        raise FileNotFoundError(
+            f"{src} not found — run `{build_hint}` (or pass --source_file)")
+    with open(src, "r", encoding="utf-8") as f:
+        text = f.read()
+    tok = make_tokenizer(text)
+    ids = np.asarray(tok.encode(text), dtype=np.uint16)
+    return write_bins(ids, out_dir, tok.meta())
 
 
 def prepare_english_prose_dataset(out_dir: str,
@@ -123,19 +139,29 @@ def prepare_english_prose_dataset(out_dir: str,
     (the reference notebook downloads its corpus over the network;
     this environment cannot): ``scripts/make_real_corpus.py`` assembles
     ~4 MB of human-written English from redistributable in-image prose
-    and commits it at data/fixtures/english_prose.txt. No synthetic
-    fallback — real data or a loud failure.
+    and commits it at data/fixtures/english_prose.txt.
     """
-    src = source_file or REAL_FIXTURE
-    if not os.path.exists(src):
-        raise FileNotFoundError(
-            f"{src} not found — run `python scripts/make_real_corpus.py` "
-            "(or pass --source_file) to build the real-text fixture")
-    with open(src, "r", encoding="utf-8") as f:
-        text = f.read()
-    tok = CharTokenizer.from_text(text)
-    ids = np.asarray(tok.encode(text), dtype=np.uint16)
-    return write_bins(ids, out_dir, tok.meta())
+    return _prepare_fixture_dataset(
+        out_dir, REAL_FIXTURE, "python scripts/make_real_corpus.py",
+        CharTokenizer.from_text, source_file)
+
+
+XL_FIXTURE = os.path.join(_REPO_ROOT, "data", "fixtures",
+                          "english_prose_xl.txt")
+
+
+def prepare_english_prose_bpe_dataset(out_dir: str,
+                                      source_file: str | None = None) -> dict:
+    """GPT-2-regime prep of the committed XL real-text fixture with the
+    committed 50,257-entry byte-BPE vocab (scripts/make_bpe_vocab.py) —
+    the zero-egress counterpart of the reference's tiktoken/OpenWebText
+    flow (ipynb:37, gh_sync.ps1:144-148). Real text, real BPE tokens, no
+    network, no synthetic fallback."""
+    return _prepare_fixture_dataset(
+        out_dir, XL_FIXTURE,
+        "python scripts/make_real_corpus.py --out "
+        "data/fixtures/english_prose_xl.txt --max_mb 100 --profile xl",
+        lambda _text: get_tokenizer("bpe"), source_file)
 
 
 def download_openwebtext(num_chars: int, dataset_name: str = "Skylion007/openwebtext"
@@ -162,13 +188,20 @@ def prepare_bpe_dataset(out_dir: str, source_files: list[str] | None = None,
                         text: str | None = None, tokenizer: str = "gpt2",
                         num_chars: int | None = None,
                         allow_synthetic: bool = True,
-                        download: bool = True) -> dict:
+                        download: bool = True,
+                        allow_byte_fallback: bool = False) -> dict:
     """OpenWebText-style prep (backlog item #22, gh_sync.ps1:144-148).
 
     Source resolution order: explicit ``text`` > ``source_files`` > streamed
     OpenWebText download (capped at ``num_chars``) > synthetic (only when
-    ``allow_synthetic``, with a loud warning). Tokenizes with GPT-2 BPE,
-    falling back to bytes when tiktoken can't fetch its vocab offline.
+    ``allow_synthetic``, with a loud warning). Tokenizes with the requested
+    tokenizer ('gpt2' tiktoken, 'bpe' committed offline vocab, 'byte').
+
+    A tokenizer that can't construct (e.g. 'gpt2' offline) FAILS by
+    default: silently producing vocab-256 byte bins for a dataset the
+    training config budgets 50k vocab for invalidates the run. Pass
+    ``allow_byte_fallback=True`` (CLI: --allow_byte_fallback) to opt into
+    the downgrade, which is then recorded loudly and in meta.pkl.
     """
     if text is None:
         chunks = []
@@ -191,7 +224,17 @@ def prepare_bpe_dataset(out_dir: str, source_files: list[str] | None = None,
         text = text[:num_chars]
     try:
         tok = get_tokenizer(tokenizer)
-    except RuntimeError:
+    except (RuntimeError, FileNotFoundError, ImportError) as e:
+        if not allow_byte_fallback:
+            raise RuntimeError(
+                f"tokenizer {tokenizer!r} unavailable and byte fallback is "
+                "opt-in (pass allow_byte_fallback=True / "
+                "--allow_byte_fallback to accept vocab-256 bins)") from e
+        import sys
+
+        print(f"WARNING: tokenizer {tokenizer!r} unavailable — downgrading "
+              "to the vocab-256 BYTE tokenizer (allow_byte_fallback=True). "
+              f"Cause: {e}", file=sys.stderr)
         tok = ByteTokenizer()
     ids = np.asarray(tok.encode(text), dtype=np.uint16)
     return write_bins(ids, out_dir, tok.meta())
@@ -202,7 +245,8 @@ def main(argv: list[str] | None = None) -> None:
 
     ap = argparse.ArgumentParser(description="prepare dataset bins")
     ap.add_argument("dataset", choices=["shakespeare_char", "openwebtext",
-                                        "english_prose_char"])
+                                        "english_prose_char",
+                                        "english_prose_bpe"])
     ap.add_argument("--data_dir", default=os.environ.get("DATA_DIR", "data"))
     ap.add_argument("--source_file", default=None)
     ap.add_argument("--num_chars", type=int,
@@ -217,6 +261,11 @@ def main(argv: list[str] | None = None) -> None:
     # DATASET_ALLOW_SYNTHETIC env var, then the per-dataset default.
     ap.add_argument("--allow_synthetic", default=None,
                     action=argparse.BooleanOptionalAction)
+    ap.add_argument("--allow_byte_fallback", action="store_true",
+                    help="accept a vocab-256 byte downgrade when the "
+                         "requested BPE tokenizer is unavailable (off by "
+                         "default: a silent downgrade invalidates runs "
+                         "configured for a 50k vocab)")
     args = ap.parse_args(argv)
     allow_synth = args.allow_synthetic
     if allow_synth is None:
@@ -227,6 +276,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.dataset == "english_prose_char":
         stats = prepare_english_prose_dataset(out_dir,
                                               source_file=args.source_file)
+    elif args.dataset == "english_prose_bpe":
+        stats = prepare_english_prose_bpe_dataset(
+            out_dir, source_file=args.source_file)
     elif args.dataset == "shakespeare_char":
         stats = prepare_char_dataset(out_dir, source_file=args.source_file,
                                      allow_synthetic=allow_synth)
@@ -234,7 +286,8 @@ def main(argv: list[str] | None = None) -> None:
         stats = prepare_bpe_dataset(
             out_dir, source_files=[args.source_file] if args.source_file else None,
             tokenizer=args.tokenizer, num_chars=args.num_chars,
-            allow_synthetic=allow_synth)
+            allow_synthetic=allow_synth,
+            allow_byte_fallback=args.allow_byte_fallback)
     print(f"prepared {args.dataset} -> {out_dir}: {stats}")
 
 

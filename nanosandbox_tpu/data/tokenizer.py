@@ -8,6 +8,7 @@ pipeline works in air-gapped clusters (proxy ConfigMap may not exist).
 
 from __future__ import annotations
 
+import os
 from typing import Protocol
 
 
@@ -63,6 +64,44 @@ class ByteTokenizer:
         return {"vocab_size": self.vocab_size, "kind": "byte"}
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BPE_ASSET = "data/fixtures/bpe_english_prose/tokenizer.json"
+
+
+class LocalBPETokenizer:
+    """Byte-level BPE from a COMMITTED vocab asset — the offline GPT-2-regime
+    tokenizer (same 50,257-entry shape as tiktoken's gpt2 encoding, which
+    the reference depends on at ipynb:37 but which needs network access).
+    Trained deterministically on the committed corpus by
+    scripts/make_bpe_vocab.py; every host tokenizes identically with no
+    download."""
+
+    def __init__(self, asset: str | None = None):
+        from tokenizers import Tokenizer as HFTokenizer
+
+        rel = asset or DEFAULT_BPE_ASSET
+        path = rel if os.path.isabs(rel) else os.path.join(_REPO_ROOT, rel)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"BPE vocab asset {path} not found — run `python "
+                "scripts/make_bpe_vocab.py` (after building the xl corpus) "
+                "or pass the asset path")
+        self.asset = rel
+        self.tok = HFTokenizer.from_file(path)
+        self.vocab_size = self.tok.get_vocab_size()
+
+    def encode(self, text: str) -> list[int]:
+        return self.tok.encode(text).ids
+
+    def decode(self, ids) -> str:
+        return self.tok.decode([int(i) for i in ids])
+
+    def meta(self) -> dict:
+        return {"vocab_size": self.vocab_size, "kind": "bpe",
+                "asset": self.asset}
+
+
 class GPT2Tokenizer:
     """GPT-2 BPE via tiktoken (the reference's tokenizer dep, ipynb:37)."""
 
@@ -88,6 +127,8 @@ def get_tokenizer(kind: str, meta: dict | None = None) -> Tokenizer:
         return CharTokenizer.from_meta(meta)
     if kind == "byte":
         return ByteTokenizer()
+    if kind == "bpe":
+        return LocalBPETokenizer((meta or {}).get("asset"))
     if kind == "gpt2":
         try:
             return GPT2Tokenizer()

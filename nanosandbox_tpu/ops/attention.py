@@ -268,12 +268,29 @@ def _pad_qkv(q, k, v, block_q, block_k, causal):
     return flat(q), flat(k), flat(v), (B, H, T, D, Tp, Dp, pad_T, pad_D)
 
 
-def _dropout_seed_arg(seed) -> jax.Array:
+def _dropout_seed_arg(seed, dropout_rate: float = 0.0) -> jax.Array:
     """Normalize the optional dropout seed to the (1,) uint32 SMEM operand
     every kernel takes (ignored when dropout_rate == 0)."""
     if seed is None:
+        if dropout_rate > 0.0:
+            # A silent constant seed would drop the SAME attention entries
+            # every step — a fixed sparsity pattern, not regularization.
+            raise ValueError(
+                "flash attention dropout needs a per-step seed ((1,) "
+                "uint32) when dropout_rate > 0")
         return jnp.zeros((1,), jnp.uint32)
     return jnp.asarray(seed, jnp.uint32).reshape((1,))
+
+
+def _check_dropout_seq_len(dropout_rate: float, padded_len: int) -> None:
+    """The keep-mask hashes q_pos * seq_len + k_pos in uint32, which is
+    collision-free only while seq_len**2 <= 2**32; beyond that, rows
+    would silently share masks (correlated dropout)."""
+    if dropout_rate > 0.0 and padded_len > 65536:
+        raise ValueError(
+            f"flash attention dropout supports sequence lengths up to "
+            f"65536 (padded {padded_len}): the positional mask hash "
+            "would wrap uint32 and correlate rows")
 
 
 def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -288,6 +305,7 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
 
+    _check_dropout_seq_len(dropout_rate, Tp)
     grid = (B * H, Tp // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
@@ -312,7 +330,7 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
-    )(_dropout_seed_arg(seed), qf, kf, vf)
+    )(_dropout_seed_arg(seed, dropout_rate), qf, kf, vf)
     out = out.reshape(B, H, Tp, Dp)[:, :, :T, :D]
     return out, lse
 
@@ -488,7 +506,8 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         lsef = jnp.concatenate([lsef, dlsef], axis=-1)
     W = lsef.shape[-1]  # LANES or 2*LANES
 
-    seed_arg = _dropout_seed_arg(seed)
+    _check_dropout_seq_len(dropout_rate, Tp)
+    seed_arg = _dropout_seed_arg(seed, dropout_rate)
     grid_q = (B * H, Tp // block_q)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,

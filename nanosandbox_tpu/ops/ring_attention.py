@@ -268,7 +268,16 @@ def clear_sharded_cache() -> None:
 def _resolve_block_impl(block_impl: str, chunk_len: int) -> str:
     """'auto' -> 'pallas' when the Mosaic kernel compiles on this backend
     AND the per-call chunk is 128-lane aligned (the flash path's full
-    [non-causal] blocks forbid T padding); 'xla' otherwise."""
+    [non-causal] blocks forbid T padding); 'xla' otherwise. A PINNED
+    pallas impl with an unaligned chunk fails here with a ring-level
+    error — previously it surfaced as a block-divisibility ValueError
+    deep inside _pad_qkv that never mentioned ring_block_impl (ADVICE r3)."""
+    if block_impl in ("pallas", "pallas_interpret") and chunk_len % 128:
+        raise ValueError(
+            f"ring_block_impl={block_impl!r} requires the per-device "
+            f"sequence chunk to be a multiple of 128 (got {chunk_len}): "
+            "non-causal ring blocks cannot pad T. Use a block_size "
+            "divisible by 128*mesh_sp, or ring_block_impl='xla'/'auto'")
     if block_impl != "auto":
         return block_impl
     if chunk_len % 128:

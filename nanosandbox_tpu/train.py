@@ -158,6 +158,12 @@ class Trainer:
             raise ValueError(
                 f"batch_size {cfg.batch_size} must be divisible by "
                 f"data*fsdp mesh shards ({dp_shards})")
+        from nanosandbox_tpu.config import resolve_loss_chunk_size
+
+        self.loss_chunk_size = resolve_loss_chunk_size(
+            cfg.loss_chunk_size, cfg.batch_size // dp_shards,
+            cfg.block_size, self.model_cfg.vocab_size,
+            seq_shards=self.mesh.shape["seq"])
         if cfg.sequences_per_iter % self.process_count:
             raise ValueError(
                 f"batch_size*accum {cfg.sequences_per_iter} must be "
@@ -282,18 +288,18 @@ class Trainer:
         # sequence parallelism the scan runs per-shard inside shard_map
         # (a scan over the T-sharded dim would otherwise force gathers,
         # and full logits at long context defeat the ring's memory story).
-        if self.cfg.loss_chunk_size > 0:
+        if self.loss_chunk_size > 0:
             hidden = self.model.apply({"params": params}, x,
                                       deterministic=deterministic,
                                       return_hidden=True, **kwargs)
             if self.mesh.shape["seq"] == 1:
                 return chunked_cross_entropy_loss(
                     hidden, params["wte"]["embedding"], y,
-                    chunk_size=self.cfg.loss_chunk_size,
+                    chunk_size=self.loss_chunk_size,
                     compute_dtype=self.cfg.compute_dtype)
             return sharded_chunked_cross_entropy_loss(
                 hidden, params["wte"]["embedding"], y, mesh=self.mesh,
-                chunk_size=self.cfg.loss_chunk_size,
+                chunk_size=self.loss_chunk_size,
                 compute_dtype=self.cfg.compute_dtype)
         logits = self.model.apply({"params": params}, x,
                                   deterministic=deterministic, **kwargs)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Train the committed byte-level BPE vocabulary for the GPT-2 regime.
+
+The reference's GPT-2-scale data contract tokenizes with tiktoken's gpt2
+encoding (/root/reference/notebooks/colab_nanoGPT_companion.ipynb:37),
+which fetches its merge table over the network — impossible in this
+zero-egress environment. The offline equivalent is a byte-level BPE of the
+SAME shape (50,257 entries: 256 byte symbols + merges, GPT-2's exact
+budget) trained deterministically on the committed real-English XL corpus
+and checked into data/fixtures/, so every host — k8s dataset Jobs, CI,
+laptops — tokenizes identically without any download.
+
+Determinism: HF `tokenizers` BPE training is deterministic for a fixed
+corpus + settings (verified by double-train comparison in
+tests/test_data.py); the manifest records the corpus sha256 so a drifted
+corpus fails loudly rather than silently re-deriving a different vocab.
+
+Usage:
+  python scripts/make_real_corpus.py --out data/fixtures/english_prose_xl.txt \
+      --max_mb 100 --profile xl        # (once) build the training corpus
+  python scripts/make_bpe_vocab.py    # train + write the vocab asset
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_CORPUS = os.path.join(REPO_ROOT, "data", "fixtures",
+                              "english_prose_xl.txt")
+DEFAULT_OUT = os.path.join(REPO_ROOT, "data", "fixtures", "bpe_english_prose")
+GPT2_VOCAB_SIZE = 50257  # GPT-2's exact entry count (tiktoken n_vocab)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def train_vocab(corpus: str, out_dir: str,
+                vocab_size: int = GPT2_VOCAB_SIZE) -> dict:
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    if not os.path.exists(corpus):
+        raise FileNotFoundError(
+            f"{corpus} not found — build it first: python "
+            "scripts/make_real_corpus.py --out data/fixtures/"
+            "english_prose_xl.txt --max_mb 100 --profile xl")
+    tok = Tokenizer(models.BPE())
+    # ByteLevel pre-tokenization = GPT-2's scheme: every byte is encodable,
+    # no <unk>, word boundaries marked with the U+0120 space marker.
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size, show_progress=False,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train([corpus], trainer)
+    got = tok.get_vocab_size()
+    if got != vocab_size:
+        raise RuntimeError(
+            f"corpus supports only {got} of the requested {vocab_size} "
+            "BPE entries — grow the corpus (make_real_corpus.py --profile "
+            "xl) before committing a smaller-than-GPT-2 vocab")
+
+    os.makedirs(out_dir, exist_ok=True)
+    asset = os.path.join(out_dir, "tokenizer.json")
+    tok.save(asset)
+    manifest = {
+        "corpus": os.path.relpath(corpus, REPO_ROOT),
+        "corpus_sha256": _sha256(corpus),
+        "vocab_size": got,
+        "scheme": "byte-level BPE (GPT-2 shape), HF tokenizers",
+        "asset_sha256": _sha256(asset),
+    }
+    with open(os.path.join(out_dir, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.write("\n")
+    return manifest
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument("--out_dir", default=DEFAULT_OUT)
+    ap.add_argument("--vocab_size", type=int, default=GPT2_VOCAB_SIZE)
+    args = ap.parse_args(argv)
+    info = train_vocab(args.corpus, args.out_dir, args.vocab_size)
+    print(f"wrote {args.out_dir}: vocab {info['vocab_size']}, "
+          f"corpus sha {info['corpus_sha256'][:12]}")
+    return info
+
+
+if __name__ == "__main__":
+    main()

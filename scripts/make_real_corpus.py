@@ -48,6 +48,17 @@ DOCSTRING_PACKAGES = [
     "sklearn", "matplotlib", "einops", "orbax",
 ]
 
+# The 'xl' profile extends the harvest for the BPE-regime corpus
+# (english_prose_xl.txt): same mechanism, more pinned permissive packages
+# (BSD-2/BSD-3/Apache-2.0 all). Kept SEPARATE from the base list because
+# the committed 4 MB english_prose.txt is built source-order-dependently
+# and truncated — changing the base list would silently change that
+# fixture on regeneration and invalidate every recorded char-level loss.
+XL_EXTRA_PACKAGES = [
+    "torch", "transformers", "tensorflow", "sympy", "networkx",
+    "nltk", "keras", "tf_keras", "pygments",
+]
+
 DOC_GLOBS = ["**/*.rst", "**/*.md", "**/LICENSE*", "**/*.txt"]
 
 _PRINTABLE = set(chr(c) for c in range(32, 127)) | {"\n"}
@@ -123,8 +134,8 @@ def harvest_doc_files(roots: list[str], any_name: bool = False):
             continue
 
 
-def harvest_docstrings(site: str):
-    for pkg in DOCSTRING_PACKAGES:
+def harvest_docstrings(site: str, packages: list[str] | None = None):
+    for pkg in (packages or DOCSTRING_PACKAGES):
         pkg_dir = os.path.join(site, pkg)
         if not os.path.isdir(pkg_dir):
             continue
@@ -152,27 +163,33 @@ def harvest_docstrings(site: str):
 _DIST_NAMES = {"sklearn": "scikit_learn", "orbax": "orbax_checkpoint"}
 
 
-def _allowed_doc_roots(site: str) -> list[str]:
+def _allowed_doc_roots(site: str,
+                       packages: list[str] | None = None) -> list[str]:
     """Doc-file harvesting is restricted to the SAME pinned package list
     as docstrings (plus those packages' dist-info license files) so the
     redistribution claim in data/fixtures/PROVENANCE.md is enforced by
     code, not assumed — an unvetted transitive dependency in the image
     can never leak into the corpus."""
     roots = []
-    for pkg in DOCSTRING_PACKAGES:
+    for pkg in (packages or DOCSTRING_PACKAGES):
         roots.append(os.path.join(site, pkg))
         dist = _DIST_NAMES.get(pkg, pkg)
         roots.extend(glob.glob(os.path.join(site, dist + "-*.dist-info")))
     return [r for r in roots if os.path.isdir(r)]
 
 
-def build(out_path: str, max_bytes: int) -> dict:
+def build(out_path: str, max_bytes: int, profile: str = "base") -> dict:
     site = sysconfig.get_paths()["purelib"]
+    packages = DOCSTRING_PACKAGES
+    if profile == "xl":
+        packages = DOCSTRING_PACKAGES + XL_EXTRA_PACKAGES
+    elif profile != "base":
+        raise ValueError(f"unknown corpus profile: {profile!r}")
     sources = [
         ("licenses", harvest_doc_files(["/usr/share/common-licenses"],
                                        any_name=True)),
-        ("package-docs", harvest_doc_files(_allowed_doc_roots(site))),
-        ("docstrings", harvest_docstrings(site)),
+        ("package-docs", harvest_doc_files(_allowed_doc_roots(site, packages))),
+        ("docstrings", harvest_docstrings(site, packages)),
     ]
     seen: set[bytes] = set()
     chunks: list[str] = []
@@ -222,8 +239,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="data/fixtures/english_prose.txt")
     ap.add_argument("--max_mb", type=float, default=4.0)
+    ap.add_argument("--profile", choices=["base", "xl"], default="base",
+                    help="base: the 4 MB char-regime fixture's pinned "
+                         "sources (do not change); xl: extended pinned "
+                         "package list for the BPE-regime corpus")
     args = ap.parse_args(argv)
-    info = build(args.out, int(args.max_mb * 1e6))
+    info = build(args.out, int(args.max_mb * 1e6), profile=args.profile)
     print(f"wrote {info['out']}: {info['bytes']:,} bytes, "
           f"char vocab {info['vocab_size']}")
     for name, s in info["stats"].items():

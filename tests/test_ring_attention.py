@@ -269,7 +269,8 @@ def test_ring_block_impl_auto_resolution():
     from nanosandbox_tpu.ops.ring_attention import _resolve_block_impl
 
     assert _resolve_block_impl("xla", 128) == "xla"
-    assert _resolve_block_impl("pallas", 77) == "pallas"  # pinned wins
+    with pytest.raises(ValueError, match="ring_block_impl"):
+        _resolve_block_impl("pallas", 77)  # pinned + unaligned: loud error
     assert _resolve_block_impl("auto", 64) == "xla"       # unaligned
     expected = "pallas" if pallas_compile_probe() else "xla"
     assert _resolve_block_impl("auto", 128) == expected
@@ -292,3 +293,14 @@ def test_model_rejects_ring_attention_dropout_directly():
     x = jnp.zeros((2, 16), jnp.int32)
     with pytest.raises(ValueError, match="dropout"):
         model.init(jax.random.key(0), x, deterministic=False)
+
+
+def test_pinned_pallas_unaligned_chunk_raises_ring_level_error():
+    """A pinned ring_block_impl='pallas' with a non-128-multiple per-device
+    chunk must fail with an error naming ring_block_impl and the chunk
+    (ADVICE r3) — not a block-divisibility ValueError deep in _pad_qkv."""
+    mesh = make_mesh(mesh_dp=1, mesh_sp=2, devices=jax.devices()[:2])
+    q, k, v = _qkv(T=64)  # 32 per device: unaligned
+    with pytest.raises(ValueError, match="ring_block_impl.*multiple of 128"):
+        jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh=mesh, block_impl="pallas"))(q, k, v)
