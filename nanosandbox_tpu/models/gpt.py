@@ -181,6 +181,22 @@ class GPT(nn.Module):
     cfg: GPTConfig
     mesh: Any = None  # bound by Trainer; needed for attention_impl='ring'
 
+    def _constrain_acts(self, x: jax.Array) -> jax.Array:
+        """Pin (B, T, C) activations to batch-over-(data, fsdp) /
+        seq-over-seq / C-replicated at the embedding lookup and between
+        blocks. Without the anchor at the wte gather, SPMD has to invert a
+        sharding transition through a gather whose table is fsdp-sharded —
+        a move it only solves by involuntary full rematerialization
+        (replicate, then re-partition; MULTICHIP_r03.json tail warning).
+        Free when the sharding already matches, which it does everywhere
+        else, so this is an anchor, not a resharding."""
+        if self.mesh is None or self.mesh.size == 1:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(("data", "fsdp"), "seq", None)))
+
     @nn.compact
     def __call__(self, idx: jax.Array, *, deterministic: bool = True,
                  return_hidden: bool = False,
@@ -212,7 +228,7 @@ class GPT(nn.Module):
             pos = cache_index + jnp.arange(T)[None, :]
         else:
             pos = jnp.arange(T)[None, :]
-        x = wte(idx) + wpe(pos)
+        x = self._constrain_acts(wte(idx) + wpe(pos))
         if cfg.dropout > 0.0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         x = x.astype(cfg.compute_dtype)
@@ -250,7 +266,8 @@ class GPT(nn.Module):
                     "(expected 'save_attention' or 'full')")
             block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic)
+            x = self._constrain_acts(
+                block_cls(cfg, mesh=self.mesh, name=f"h_{i}")(x, deterministic))
 
         x = _layer_norm(cfg, "ln_f")(x)
         if return_hidden:

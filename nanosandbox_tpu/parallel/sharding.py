@@ -54,9 +54,18 @@ def spec_for_param(path: str, shape: tuple[int, ...], *, axis_sizes: dict,
             placement[d] = "model"
 
     if shard_params and axis_sizes["fsdp"] > 1:
-        # Shard the largest still-free, divisible dim over fsdp.
+        # Shard the largest still-free, divisible dim over fsdp — except
+        # embedding tables, which may only shard their ROW (vocab/position)
+        # dim: a feature-dim-sharded table turns every lookup into a gather
+        # whose output is C-sharded, and SPMD can only move that back to
+        # the C-replicated activation layout via involuntary full
+        # rematerialization (replicate-then-repartition; the
+        # MULTICHIP_r03.json spmd_partitioner.cc warning). Row-sharded
+        # gathers lower to the clean masked-gather + psum pattern.
+        allowed = ((0,) if path.endswith("wte/embedding")
+                   or path.endswith("wpe/embedding") else range(ndim))
         candidates = sorted(
-            (i for i in range(ndim)
+            (i for i in allowed
              if placement[i] is None and shape[i] % axis_sizes["fsdp"] == 0
              and shape[i] >= axis_sizes["fsdp"]),
             key=lambda i: shape[i], reverse=True)

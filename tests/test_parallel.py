@@ -46,9 +46,18 @@ def test_spec_rules():
     s = spec_for_param("h_0/attn/c_proj/kernel", (64, 64),
                        axis_sizes=sizes, shard_params=True, tp=True)
     assert s == P("model", "fsdp")
+    # Embedding tables only ever shard their ROW dim: a feature-sharded
+    # table makes every lookup a C-sharded gather that SPMD can only
+    # un-shard via involuntary full rematerialization (sharding.py).
     s = spec_for_param("wte/embedding", (65, 64),
                        axis_sizes=sizes, shard_params=True, tp=True)
-    assert s == P(None, "fsdp")  # 65 not divisible by 2
+    assert s == P()  # 65 not divisible by 2 -> replicate, NEVER P(None, 'fsdp')
+    s = spec_for_param("wte/embedding", (64, 32),
+                       axis_sizes=sizes, shard_params=True, tp=True)
+    assert s == P("fsdp", None)  # divisible row dim -> row-sharded
+    s = spec_for_param("wpe/embedding", (30, 64),
+                       axis_sizes=sizes, shard_params=True, tp=True)
+    assert s == P("fsdp", None)
     s = spec_for_param("ln_f/scale", (64,),
                        axis_sizes=sizes, shard_params=False, tp=True)
     assert s == P()
@@ -101,6 +110,11 @@ def test_dp_matches_single_device_loss(tiny_cfg):
                       devices=jax.devices()[:1])
     t2 = Trainer(cfg1)
     t2.mesh = mesh1
+    # The model binds the mesh at construction (ring attention + the
+    # activation-sharding anchors), so swapping the trainer's mesh must
+    # rebuild the model too or the anchors would target retired devices.
+    from nanosandbox_tpu.models.gpt import GPT
+    t2.model = GPT(t2.model_cfg, mesh=mesh1)
     from nanosandbox_tpu.parallel.mesh import batch_sharding as bs
     t2.batch_sharding = bs(mesh1)
     # re-derive shardings for the single-device mesh
