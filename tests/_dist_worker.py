@@ -56,6 +56,16 @@ def worker_config(mode: str, data_dir: str, out_dir: str):
     elif mode == "fsdp4sp2":
         base.update(batch_size=8, mesh_fsdp=4, mesh_sp=2,
                     shard_params=True, attention_impl="ring")
+    elif mode == "faulttol":
+        # Full Trainer.run() against a SHARED out_dir (the k8s RWX-PV
+        # contract, README.md:76): Orbax-coordinated checkpoints every 3
+        # iters, init_from=auto so a restarted pod with the same ordinal
+        # resumes instead of restarting from scratch (SURVEY.md §5
+        # restart-with-stable-identity).
+        base.update(max_iters=int(os.environ.get("FT_MAX_ITERS", "48")),
+                    eval_interval=3, eval_iters=2, log_interval=1,
+                    init_from="auto", always_save_checkpoint=True,
+                    warmup_iters=2, lr_decay_iters=48)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
     return TrainConfig(**base)
@@ -73,6 +83,12 @@ def main() -> None:
     assert trainer.process_count == 2, trainer.process_count
     print(f"WORKER process {trainer.process_index}/{trainer.process_count} "
           f"devices={jax.device_count()} local={jax.local_device_count()}")
+
+    if mode == "faulttol":
+        result = trainer.run()
+        print(f"RUN_RESULT iter={result['iter_num']} "
+              f"final_loss={result['final_loss']:.8f}")
+        return
 
     state = trainer.init_state()
     train_step, _ = trainer.compiled_steps()

@@ -15,6 +15,7 @@ import re
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -103,6 +104,111 @@ def _single_process_reference(mode: str, char_dataset, tmp_path):
     _, m = step(state, trainer.to_global(xg), trainer.to_global(yg),
                 jax.random.key(0))
     return float(m["loss"]), float(m["grad_norm"])
+
+
+def _launch_faulttol(char_dataset, out_dir: str, max_iters: int):
+    """Two Trainer.run() workers against a SHARED out_dir (the RWX-PV
+    layout), identity from the StatefulSet hostname ordinal."""
+    port = _free_port()
+    procs = []
+    for i in range(2):
+        env = os.environ.copy()
+        env.update({
+            "HOSTNAME": f"train-multipod-{i}",
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "FT_MAX_ITERS": str(max_iters),
+        })
+        env.pop("PROCESS_ID", None)
+        env["XLA_FLAGS"] = ""
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, char_dataset, out_dir, "faulttol"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    return procs
+
+
+def _committed_ckpt_steps(out_dir: str) -> set[int]:
+    """Committed Orbax steps: local-FS commit is an atomic rename from a
+    '<step>.orbax-checkpoint-tmp-*' dir to a bare '<step>' dir, so a
+    digit-named directory existing == the checkpoint is complete."""
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    if not os.path.isdir(ckpt_dir):
+        return set()
+    return {int(d) for d in os.listdir(ckpt_dir) if d.isdigit()}
+
+
+def _drain(procs, timeout=600):
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    return outs
+
+
+def test_fault_injection_kill_and_resume(char_dataset, tmp_path):
+    """The reference's failure catalogue is pod-death-with-stable-identity
+    (/root/reference/README.md:116-120): a worker dies mid-run, the
+    StatefulSet restarts it under the SAME hostname ordinal, and the job
+    must resume from the shared-PV checkpoint. Here: SIGKILL worker 1
+    after the iter-3 Orbax checkpoint commits, restart BOTH workers (a
+    dead collective peer takes the whole SPMD job down — same as NCCL)
+    with identical env, and require the resumed run's final loss to EQUAL
+    the uninterrupted reference — the loader is step-indexed and the
+    trajectory deterministic, so recovery is exact, not approximate."""
+    iters = 24
+    ref_dir = str(tmp_path / "ref")
+    procs = _launch_faulttol(char_dataset, ref_dir, iters)
+    outs = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"ref worker {i} failed:\n{out}"
+    m = re.search(r"RUN_RESULT iter=(\d+) final_loss=(\S+)", outs[0])
+    assert m and int(m.group(1)) == iters, outs[0]
+    ref_loss = float(m.group(2))
+
+    shared = str(tmp_path / "shared")
+    procs = _launch_faulttol(char_dataset, shared, iters)
+    try:
+        deadline = time.time() + 300
+        while not _committed_ckpt_steps(shared):
+            assert time.time() < deadline, "no checkpoint appeared in 300s"
+            assert procs[1].poll() is None, (
+                "worker 1 exited before any checkpoint committed:\n"
+                + procs[1].communicate()[0])
+            time.sleep(0.2)
+        # Fault: kill worker 1 the instant a checkpoint is committed —
+        # mid-run by construction (24 iters + 7 more eval/ckpt blocks
+        # remain at this point).
+        assert procs[1].poll() is None, "worker 1 finished too early"
+        procs[1].kill()
+        killed_after = max(_committed_ckpt_steps(shared))
+        # Worker 0 now has a dead collective peer; it can only hang or
+        # crash, never finish (assert it did not race to completion).
+        time.sleep(2.0)
+        procs[0].kill()
+    finally:
+        _drain(procs, timeout=60)
+    assert killed_after < iters
+
+    # Restart with the SAME ordinal identity; init_from=auto must resume
+    # from the committed step, not restart from scratch.
+    procs = _launch_faulttol(char_dataset, shared, iters)
+    outs = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"restarted worker {i} failed:\n{out}"
+    resumed = re.search(r"resumed from iter (\d+)", outs[0])
+    assert resumed, f"restart did not resume from checkpoint:\n{outs[0]}"
+    assert int(resumed.group(1)) >= killed_after >= 3
+    m = re.search(r"RUN_RESULT iter=(\d+) final_loss=(\S+)", outs[0])
+    assert m and int(m.group(1)) == iters, outs[0]
+    assert float(m.group(2)) == pytest.approx(ref_loss, rel=1e-6), (
+        f"resumed trajectory diverged: {m.group(2)} vs {ref_loss}")
 
 
 @pytest.mark.parametrize("mode", ["fsdp8", "fsdp4sp2"])
