@@ -73,6 +73,11 @@ class TrainConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"  # MXU-native
     attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla' | 'ring'
+    # Flash-backward softmax-stat operand layout: 'replicated' broadcasts
+    # per-row stats across the 128-lane minor dim (always lowers);
+    # 'compact' stores them dense as (Tp/128, 128) rows and expands tiles
+    # in-register — ~128x less stat HBM traffic (ops/attention.py).
+    attention_stat_layout: str = "replicated"
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
     # What remat saves: 'save_attention' keeps each block's attention
     # output (tagged checkpoint_name) so the backward never re-runs the
@@ -287,6 +292,7 @@ class GPTConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     attention_impl: str = "auto"
+    attention_stat_layout: str = "replicated"
     ring_layout: str = "zigzag"
     ring_block_impl: str = "auto"
     remat: bool = False
@@ -305,6 +311,7 @@ class GPTConfig:
             param_dtype=cfg.param_dtype,
             compute_dtype=cfg.compute_dtype,
             attention_impl=cfg.attention_impl,
+            attention_stat_layout=cfg.attention_stat_layout,
             ring_layout=cfg.ring_layout,
             ring_block_impl=cfg.ring_block_impl,
             remat=cfg.remat,

@@ -122,7 +122,8 @@ class CausalSelfAttention(nn.Module):
                 attn_rng = self.make_rng("dropout")
             y = causal_attention(q, k, v, impl=cfg.attention_impl,
                                  dropout_rate=0.0 if deterministic else cfg.dropout,
-                                 dropout_rng=attn_rng)
+                                 dropout_rng=attn_rng,
+                                 stat_layout=cfg.attention_stat_layout)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
 
         proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5

@@ -339,6 +339,19 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
 # Pallas flash backward
 # ---------------------------------------------------------------------------
 #
+# Stat-operand layouts (--attention_stat_layout):
+#   'replicated' (default): per-row stats broadcast across the 128-lane
+#     minor dim, (B*H, Tp, LANES) f32 — every BlockSpec trivially legal,
+#     but the backward streams ~128x more stat bytes from HBM than the
+#     information content (~400 MB/layer/step at the 124M bench shape).
+#   'compact': the (Tp,) stat vector reshaped to (Tp//LANES, LANES) rows —
+#     dense in HBM (the minor dim carries REAL data, so XLA's (8, 128)
+#     tiling pads nothing). The catch: inside the kernel the (rows, LANES)
+#     tile must become a (block_q, 1) column, a cross-lane -> sublane
+#     relayout Mosaic cannot express as a plain reshape. _expand_stat_tile
+#     does it with a tiny selection matmul + masked rowsum — ops that
+#     always lower (MXU + VPU), no relayout primitive needed.
+#
 # Standard flash-attention backward split into two kernels sharing the
 # forward's per-row logsumexp L and the precomputed row term
 # Drow = rowsum(dO * O):
@@ -349,10 +362,43 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
 # (T, T)), dP = dO @ V^T, dS = P * (dP - Drow). The causal frontier skips
 # fully-masked blocks, halving the work the XLA-recompute backward did.
 
+def _expand_stat_tile(tile: jax.Array, row_offset, block_q: int) -> jax.Array:
+    """FULL compact stat tile (R, LANES) -> the (block_q, 1) column for
+    global rows [row_offset*LANES, row_offset*LANES + block_q), where
+    tile[r, c] holds the stat for global row r*LANES + c.
+
+    The needed cross-lane -> sublane relayout is built from ops Mosaic
+    always lowers: a (block_q, R) 0/1 selection matmul (which also absorbs
+    the q-block's row offset — Mosaic forbids sub-8-sublane stat blocks
+    AND dynamic sublane slicing at unaligned offsets, so selecting rows
+    via the contraction sidesteps both) replicates each stat row across
+    the 128 q-rows it covers, then a masked rowsum picks each q-row's own
+    lane. ~block_q*R MACs + block_q*LANES VPU ops — noise against the
+    (bq, bk) @ (bk, D) main matmuls. row_offset may be a traced scalar
+    (it is grid-position-dependent)."""
+    R, lanes = tile.shape
+    sel = (lax.broadcasted_iota(jnp.int32, (block_q, R), 0) // lanes
+           + row_offset
+           == lax.broadcasted_iota(jnp.int32, (block_q, R), 1))
+    # HIGHEST precision: each output element sums exactly ONE tile value,
+    # so full-f32 passes make the expansion bit-exact (default MXU f32
+    # precision would round lse to ~bf16 and visibly perturb p = exp(s-L));
+    # the matmul is tiny, the extra passes are free.
+    spread = lax.dot_general(sel.astype(jnp.float32), tile,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=lax.Precision.HIGHEST)  # (bq, lanes)
+    own_lane = (lax.broadcasted_iota(jnp.int32, (block_q, lanes), 1)
+                == lax.broadcasted_iota(jnp.int32, (block_q, lanes), 0)
+                % lanes)
+    return jnp.sum(jnp.where(own_lane, spread, 0.0), axis=1, keepdims=True)
+
+
 def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
                          lse_ref, dq_ref, *, block_q: int, block_k: int,
                          sm_scale: float, causal: bool, has_dlse: bool,
-                         dropout_rate: float = 0.0):
+                         dropout_rate: float = 0.0,
+                         stat_layout: str = "replicated"):
     qi = pl.program_id(1)
     if dropout_rate > 0.0:
         mix = _dropout_tile_seed(seed_ref, pl.program_id(0))
@@ -366,11 +412,20 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
     # in-register; only memory-ref blocks must tile to (8, 128).
     drow = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                    axis=1, keepdims=True)            # (bq, 1) f32
-    if has_dlse:
-        # lse_ref carries [lse | dlse] stacked on the minor dim; fold the
-        # lse cotangent into the row term (ds = p * (dp - (drow - dlse))).
-        drow = drow - lse_ref[0][:, LANES:LANES + 1]
-    lse = lse_ref[0][:, :1]                          # (bq, 1) f32
+    if stat_layout == "compact":
+        # lse_ref block: (1, S, Tp//LANES, LANES) full dense rows; the
+        # expansion matmul selects this q block's slice.
+        row0 = qi * (block_q // LANES)
+        lse = _expand_stat_tile(lse_ref[0, 0], row0, block_q)
+        if has_dlse:
+            # Fold the lse cotangent into the row term
+            # (ds = p * (dp - (drow - dlse))).
+            drow = drow - _expand_stat_tile(lse_ref[0, 1], row0, block_q)
+    else:
+        if has_dlse:
+            # lse_ref carries [lse | dlse] stacked on the minor dim.
+            drow = drow - lse_ref[0][:, LANES:LANES + 1]
+        lse = lse_ref[0][:, :1]                      # (bq, 1) f32
     seq_len = k_ref.shape[1]
     num_kb = (lax.div((qi + 1) * block_q + block_k - 1, block_k)
               if causal else seq_len // block_k)
@@ -410,7 +465,8 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
 def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
                           lse_ref, dk_ref, dv_ref, *, block_q: int,
                           block_k: int, sm_scale: float, causal: bool,
-                          has_dlse: bool, dropout_rate: float = 0.0):
+                          has_dlse: bool, dropout_rate: float = 0.0,
+                          stat_layout: str = "replicated"):
     ki = pl.program_id(1)
     if dropout_rate > 0.0:
         mix = _dropout_tile_seed(seed_ref, pl.program_id(0))
@@ -431,10 +487,16 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
             do.astype(jnp.float32)
             * o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32),
             axis=1, keepdims=True)                    # (bq, 1) f32
-        stats = lse_ref[0, pl.ds(i * block_q, block_q), :]
-        if has_dlse:
-            drow = drow - stats[:, LANES:LANES + 1]
-        lse = stats[:, :1]                            # (bq, 1) f32
+        if stat_layout == "compact":
+            row0 = i * (block_q // LANES)
+            lse = _expand_stat_tile(lse_ref[0, 0], row0, block_q)
+            if has_dlse:
+                drow = drow - _expand_stat_tile(lse_ref[0, 1], row0, block_q)
+        else:
+            stats = lse_ref[0, pl.ds(i * block_q, block_q), :]
+            if has_dlse:
+                drow = drow - stats[:, LANES:LANES + 1]
+            lse = stats[:, :1]                        # (bq, 1) f32
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -476,35 +538,63 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
                       block_q: int = DEFAULT_BLOCK,
                       block_k: int = DEFAULT_BLOCK,
                       interpret: bool = False, dlse=None,
-                      dropout_rate: float = 0.0, seed=None):
-    """lse arrives compact and T-padded from the forward: (B*H, Tp, 1)
-    f32; both row stats are lane-replicated transiently here.
+                      dropout_rate: float = 0.0, seed=None,
+                      stat_layout: str = "replicated"):
+    """lse arrives compact and T-padded from the forward: (B*H, Tp, 1) f32.
+
+    stat_layout picks the HBM operand the kernels read it through:
+    'replicated' broadcasts both row stats across the 128-lane minor dim
+    (transiently, here); 'compact' reshapes the dense vector to
+    (Tp//LANES, LANES) rows and the kernels expand tiles in-register
+    (_expand_stat_tile) — ~128x less stat traffic.
 
     dlse (optional, (B, H, T) f32): cotangent of the logsumexp output for
     callers of flash_attention_lse. Since d lse / d s = p, the extra term
     folds into the existing row stat: ds = p * (dp - (drow - dlse)).
     dV has no lse dependence (dv = p^T do only)."""
+    if stat_layout not in ("replicated", "compact"):
+        raise ValueError(f"unknown attention stat_layout: {stat_layout!r} "
+                         "(expected 'replicated' or 'compact')")
     block_q, block_k = _clamp_blocks(q.shape[2], block_q, block_k)
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
     dof = _pad_qkv(do, do, do, block_q, block_k, causal)[0]
     of = _pad_qkv(o, o, o, block_q, block_k, causal)[0]
-    # Per-row softmax stats, lane-replicated (the only layout Mosaic can
-    # block on the minor dim). Drow is NOT built here any more — both
-    # kernels recompute it in-register from (do, o), which they read
-    # anyway. When the caller supplies a dlse cotangent
-    # (flash_attention_lse), it rides along stacked after lse on the
-    # minor dim so the kernels keep a single stats operand.
-    lsef = jnp.broadcast_to(lse, (B * H, Tp, LANES))
+    # Drow is NOT built here — both kernels recompute it in-register from
+    # (do, o), which they read anyway. When the caller supplies a dlse
+    # cotangent (flash_attention_lse), it rides along in the same stats
+    # operand so the kernels keep a single stats ref.
     has_dlse = dlse is not None
+    dlsef = None
     if has_dlse:
         d = dlse.astype(jnp.float32)
         if pad_T:
             d = jnp.pad(d, [(0, 0), (0, 0), (0, pad_T)])
-        dlsef = jnp.broadcast_to(d.reshape(B * H, Tp, 1),
-                                 (B * H, Tp, LANES))
-        lsef = jnp.concatenate([lsef, dlsef], axis=-1)
-    W = lsef.shape[-1]  # LANES or 2*LANES
+        dlsef = d.reshape(B * H, Tp, 1)
+    if stat_layout == "compact":
+        # (B*H, S, Tp//LANES, LANES): dense rows, S in {1, 2} stacks
+        # [lse, dlse?] on a dedicated dim so one contiguous block serves
+        # each q-block's slice of both stats.
+        parts = [lse[..., 0].reshape(B * H, Tp // LANES, LANES)]
+        if has_dlse:
+            parts.append(dlsef[..., 0].reshape(B * H, Tp // LANES, LANES))
+        statsf = jnp.stack(parts, axis=1)
+        S = len(parts)
+        # Both kernels take the FULL (tiny: Tp*4 bytes/bh) stats block —
+        # Mosaic requires the last two block dims be 8/128-divisible OR
+        # equal to the array dims, and block_q//LANES rows is neither.
+        full_stats = pl.BlockSpec((1, S, Tp // LANES, LANES),
+                                  lambda b, i: (b, 0, 0, 0))
+        dq_stats_spec = dkv_stats_spec = full_stats
+    else:
+        statsf = jnp.broadcast_to(lse, (B * H, Tp, LANES))
+        if has_dlse:
+            statsf = jnp.concatenate(
+                [statsf, jnp.broadcast_to(dlsef, (B * H, Tp, LANES))],
+                axis=-1)
+        W = statsf.shape[-1]  # LANES or 2*LANES
+        dq_stats_spec = pl.BlockSpec((1, block_q, W), lambda b, i: (b, i, 0))
+        dkv_stats_spec = pl.BlockSpec((1, Tp, W), lambda b, j: (b, 0, 0))
 
     _check_dropout_seq_len(dropout_rate, Tp)
     seed_arg = _dropout_seed_arg(seed, dropout_rate)
@@ -512,7 +602,8 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, sm_scale=sm_scale, causal=causal,
-                          has_dlse=has_dlse, dropout_rate=dropout_rate),
+                          has_dlse=has_dlse, dropout_rate=dropout_rate,
+                          stat_layout=stat_layout),
         grid=grid_q,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -521,20 +612,21 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, W), lambda b, i: (b, i, 0)),
+            dq_stats_spec,
         ],
         out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
-    )(seed_arg, qf, kf, vf, of, dof, lsef)
+    )(seed_arg, qf, kf, vf, of, dof, statsf)
 
     grid_k = (B * H, Tp // block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, sm_scale=sm_scale, causal=causal,
-                          has_dlse=has_dlse, dropout_rate=dropout_rate),
+                          has_dlse=has_dlse, dropout_rate=dropout_rate,
+                          stat_layout=stat_layout),
         grid=grid_k,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -543,7 +635,7 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tp, W), lambda b, j: (b, 0, 0)),
+            dkv_stats_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
@@ -556,17 +648,20 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
-    )(seed_arg, qf, kf, vf, of, dof, lsef)
+    )(seed_arg, qf, kf, vf, of, dof, statsf)
 
     unpad = lambda g: g.reshape(B, H, Tp, Dp)[:, :, :T, :D]
     return (unpad(dq).astype(q.dtype), unpad(dk).astype(k.dtype),
             unpad(dv).astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
-                    interpret: bool = False):
-    """Flash attention: Pallas forward AND backward (both causal-aware)."""
+                    interpret: bool = False, stat_layout: str = "replicated"):
+    """Flash attention: Pallas forward AND backward (both causal-aware).
+
+    stat_layout ('replicated' | 'compact') picks the backward's softmax-
+    stat operand layout; forward math is identical either way."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     out, _ = _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
@@ -574,7 +669,8 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
+def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret,
+                    stat_layout="replicated"):
     from jax.ad_checkpoint import checkpoint_name
 
     if sm_scale is None:
@@ -596,22 +692,24 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
     return o, (q, k, v, o, checkpoint_name(lse[..., :1], "attn_lse"))
 
 
-def _flash_bwd_rule(causal, sm_scale, interpret, res, do):
+def _flash_bwd_rule(causal, sm_scale, interpret, stat_layout, res, do):
     q, k, v, o, lse = res
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _pallas_flash_bwd(q, k, v, o, lse, do, causal=causal,
-                             sm_scale=sm_scale, interpret=interpret)
+                             sm_scale=sm_scale, interpret=interpret,
+                             stat_layout=stat_layout)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_dropout(q, k, v, seed, causal: bool = True,
                             sm_scale: float | None = None,
                             dropout_rate: float = 0.0,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            stat_layout: str = "replicated"):
     """Flash attention with attention-probability dropout IN the kernels.
 
     Semantically o = dropout(softmax(s)) @ v — identical regularization to
@@ -635,7 +733,7 @@ def flash_attention_dropout(q, k, v, seed, causal: bool = True,
 
 
 def _flash_dropout_fwd_rule(q, k, v, seed, causal, sm_scale, dropout_rate,
-                            interpret):
+                            interpret, stat_layout="replicated"):
     from jax.ad_checkpoint import checkpoint_name
 
     if sm_scale is None:
@@ -648,13 +746,14 @@ def _flash_dropout_fwd_rule(q, k, v, seed, causal, sm_scale, dropout_rate,
 
 
 def _flash_dropout_bwd_rule(causal, sm_scale, dropout_rate, interpret,
-                            res, do):
+                            stat_layout, res, do):
     q, k, v, o, lse, seed = res
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     dq, dk, dv = _pallas_flash_bwd(q, k, v, o, lse, do, causal=causal,
                                    sm_scale=sm_scale, interpret=interpret,
-                                   dropout_rate=dropout_rate, seed=seed)
+                                   dropout_rate=dropout_rate, seed=seed,
+                                   stat_layout=stat_layout)
     return dq, dk, dv, None
 
 
@@ -662,10 +761,11 @@ flash_attention_dropout.defvjp(_flash_dropout_fwd_rule,
                                _flash_dropout_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_lse(q, k, v, causal: bool = True,
                         sm_scale: float | None = None,
-                        interpret: bool = False):
+                        interpret: bool = False,
+                        stat_layout: str = "replicated"):
     """Flash attention that ALSO returns the per-row logsumexp.
 
     Returns (out (B, H, T, D), lse (B, H, T) f32) where
@@ -690,7 +790,8 @@ def _compact_lse(lse, qshape):
     return lse[:, :T, 0].reshape(B, H, T)
 
 
-def _flash_lse_fwd_rule(q, k, v, causal, sm_scale, interpret):
+def _flash_lse_fwd_rule(q, k, v, causal, sm_scale, interpret,
+                        stat_layout="replicated"):
     from jax.ad_checkpoint import checkpoint_name
 
     if sm_scale is None:
@@ -702,14 +803,14 @@ def _flash_lse_fwd_rule(q, k, v, causal, sm_scale, interpret):
             (q, k, v, o, checkpoint_name(lse[..., :1], "attn_lse")))
 
 
-def _flash_lse_bwd_rule(causal, sm_scale, interpret, res, cts):
+def _flash_lse_bwd_rule(causal, sm_scale, interpret, stat_layout, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _pallas_flash_bwd(q, k, v, o, lse, do, causal=causal,
                              sm_scale=sm_scale, interpret=interpret,
-                             dlse=dlse)
+                             dlse=dlse, stat_layout=stat_layout)
 
 
 flash_attention_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
@@ -834,13 +935,18 @@ def _probe_locally() -> bool:
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                      impl: str = "auto", sm_scale: float | None = None,
                      dropout_rate: float = 0.0,
-                     dropout_rng: jax.Array | None = None) -> jax.Array:
+                     dropout_rng: jax.Array | None = None,
+                     stat_layout: str = "replicated") -> jax.Array:
     """Causal attention over (B, H, T, D) tensors.
 
     impl: 'auto' (Pallas on TPU when it compiles, XLA otherwise — a probe
     compiles the kernel once per process so a kernel regression degrades
     to XLA instead of crashing), 'pallas', 'pallas_interpret' (for CPU
     tests), 'pallas_jax' (jax's library kernel), or 'xla'.
+
+    stat_layout ('replicated' | 'compact'): the flash backward's softmax-
+    stat operand layout (--attention_stat_layout); ignored by the
+    xla/pallas_jax paths.
 
     Attention-probability dropout runs INSIDE the flash kernels
     (flash_attention_dropout) for the pallas impls; 'pallas_jax' has no
@@ -855,14 +961,15 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             seed = jax.random.bits(dropout_rng, (1,), jnp.uint32)
             return flash_attention_dropout(q, k, v, seed, True, sm_scale,
                                            float(dropout_rate),
-                                           impl == "pallas_interpret")
+                                           impl == "pallas_interpret",
+                                           stat_layout)
         return xla_attention(q, k, v, causal=True, sm_scale=sm_scale,
                              dropout_rate=dropout_rate,
                              dropout_rng=dropout_rng)
     if impl == "xla":
         return xla_attention(q, k, v, causal=True, sm_scale=sm_scale)
     if impl == "pallas":
-        return flash_attention(q, k, v, True, sm_scale, False)
+        return flash_attention(q, k, v, True, sm_scale, False, stat_layout)
     if impl == "pallas_jax":
         out = _jax_tpu_flash(q, k, v, sm_scale if sm_scale is not None
                              else q.shape[-1] ** -0.5)
@@ -871,5 +978,5 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              "(requires a TPU backend)")
         return out
     if impl == "pallas_interpret":
-        return flash_attention(q, k, v, True, sm_scale, True)
+        return flash_attention(q, k, v, True, sm_scale, True, stat_layout)
     raise ValueError(f"unknown attention impl: {impl!r}")

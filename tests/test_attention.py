@@ -303,3 +303,98 @@ def test_flash_lse_gradients_including_dlse():
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-4, rtol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# Compact backward-stat layout (--attention_stat_layout=compact)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("T,D", [(128, 64), (256, 32), (640, 64)])
+def test_compact_stat_layout_gradients_match_replicated(T, D):
+    """'compact' must be a pure layout change: gradients bit-comparable to
+    the replicated path at every shape class (single stat row, multiple
+    rows, non-block-multiple T that exercises padding)."""
+    rng = np.random.default_rng(21)
+    q, k, v = rand_qkv(rng, T=T, D=D)
+
+    def loss(layout):
+        def f(q, k, v):
+            return (flash_attention(q, k, v, True, None, True, layout)
+                    ** 2).sum()
+        return f
+
+    gr = jax.grad(loss("replicated"), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(loss("compact"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_compact_stat_layout_matches_xla_gradients():
+    rng = np.random.default_rng(22)
+    q, k, v = rand_qkv(rng, T=256, D=64)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True, None, True, "compact").sum()
+
+    def loss_ref(q, k, v):
+        return xla_attention(q, k, v, causal=True).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_compact_stat_layout_dropout_gradients_match_replicated():
+    """The same keep-mask is positional, so dropout gradients must also be
+    layout-invariant."""
+    from nanosandbox_tpu.ops.attention import flash_attention_dropout
+
+    rng = np.random.default_rng(23)
+    q, k, v = rand_qkv(rng, T=256, D=32)
+    seed = jnp.asarray([1234], jnp.uint32)
+
+    def loss(layout):
+        def f(q, k, v):
+            return (flash_attention_dropout(q, k, v, seed, True, None, 0.2,
+                                            True, layout) ** 2).sum()
+        return f
+
+    gr = jax.grad(loss("replicated"), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(loss("compact"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_compact_stat_layout_dlse_gradients_match_replicated():
+    """flash_attention_lse's dlse cotangent rides in the stacked stats
+    operand — the S=2 compact path."""
+    from nanosandbox_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.default_rng(24)
+    mk = lambda: jnp.asarray(rng.normal(size=(1, 2, 256, 32)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    w = jnp.asarray(rng.normal(size=(1, 2, 256)), jnp.float32)
+
+    def loss(layout):
+        def f(q, k, v):
+            out, lse = flash_attention_lse(q, k, v, True, None, True, layout)
+            return (out ** 2).sum() + (lse * w).sum()
+        return f
+
+    gr = jax.grad(loss("replicated"), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(loss("compact"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_stat_layout_rejects_unknown():
+    rng = np.random.default_rng(25)
+    q, k, v = rand_qkv(rng, T=128, D=32)
+    with pytest.raises(ValueError, match="stat_layout"):
+        jax.grad(lambda q: flash_attention(q, k, v, True, None, True,
+                                           "bogus").sum())(q)
