@@ -181,3 +181,69 @@ def test_flash_lse_gradients_compile_with_dlse_on_tpu():
         a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
         scale = max(np.abs(b32).max(), 1e-8)
         assert np.abs(a32 - b32).max() / scale < 1e-2
+
+
+def test_compact_stat_layout_bitwise_on_hardware():
+    """--attention_stat_layout=compact must be a PURE layout change on the
+    real chip: the HIGHEST-precision selection matmul in _expand_stat_tile
+    makes the expanded lse bit-identical to the replicated operand, so
+    gradients match bitwise (not just within tolerance). Catches any
+    Mosaic lowering drift in the expansion path that interpret mode
+    cannot see."""
+    rng = np.random.default_rng(31)
+    q, k, v = rand_qkv(rng)
+
+    def grads(layout):
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, True, None, False, layout)
+                    .astype(jnp.float32) ** 2).sum()
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    for a, b in zip(grads("replicated"), grads("compact")):
+        assert bool(jnp.array_equal(a, b)), "compact layout changed gradients"
+
+
+def test_kv_cached_decode_matches_full_forward_on_hardware():
+    """Per-position logits parity of the cached decode path under real
+    Mosaic/XLA compilation (the CPU tier pins the same contract in
+    interpret-free f32; this exercises the bf16 compiled path)."""
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT, init_cache
+
+    cfg = GPTConfig(n_layer=2, n_head=4, n_embd=256, block_size=256,
+                    vocab_size=512, dropout=0.0, compute_dtype="bfloat16",
+                    attention_impl="auto")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    idx = jax.random.randint(jax.random.key(1), (2, 48), 0, 512, jnp.int32)
+
+    ref = jax.jit(lambda p, x: model.apply({"params": p}, x,
+                                           deterministic=True))(params, idx)
+
+    @jax.jit
+    def cached(params, idx):
+        cache = init_cache(cfg, 2, 48)
+        logits, cache = model.apply({"params": params}, idx[:, :16],
+                                    deterministic=True, cache=cache,
+                                    cache_index=0)
+        chunks = [logits]
+        for i in range(16, 48):
+            logits, cache = model.apply({"params": params}, idx[:, i:i + 1],
+                                        deterministic=True, cache=cache,
+                                        cache_index=i)
+            chunks.append(logits)
+        return jnp.concatenate(chunks, axis=1)
+
+    got = cached(params, idx)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=0.15, rtol=0.05)
+    # Greedy agreement: random-weight logits at vocab 512 are nearly
+    # uniform, so bf16 rounding between the two compiled programs can flip
+    # argmax at genuine near-ties — require broad agreement, not equality
+    # (the CPU tier pins exact greedy parity where both paths share one
+    # numeric regime; trained checkpoints have real margins).
+    agree = jnp.mean((jnp.argmax(got, -1) == jnp.argmax(ref, -1))
+                     .astype(jnp.float32))
+    assert float(agree) > 0.9, f"greedy agreement only {float(agree):.2%}"
