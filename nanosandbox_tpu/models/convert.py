@@ -1,7 +1,10 @@
-"""Pretrained GPT-2 weight import: HF transformers -> this model's pytree.
+"""GPT-2 weight conversion: HF transformers <-> this model's pytree.
 
-The reference's training core supports `--init_from=gpt2*` (nanoGPT loads
-the HF GPT-2 family and fine-tunes); this is the TPU-native counterpart.
+Import: the reference's training core supports `--init_from=gpt2*`
+(nanoGPT loads the HF GPT-2 family and fine-tunes); this is the
+TPU-native counterpart. Export (export_hf_gpt2 / the module CLI) is the
+inverse — a TPU-trained checkpoint becomes a save_pretrained directory
+the HF ecosystem loads directly.
 The mapping is mechanical because the model was built name-compatible:
 
     transformer.wte.weight            -> wte.embedding   (tied lm_head)
@@ -126,3 +129,144 @@ def resolve_init_from(init_from: str) -> str | None:
     if init_from.startswith("hf:"):
         return init_from[3:]
     return None
+
+
+# ---------------------------------------------------------------------------
+# Export: this model's pytree -> HF save_pretrained directory
+# ---------------------------------------------------------------------------
+#
+# The inverse of the import above, completing the round trip a reference
+# user expects: fine-tune on TPU, then hand the checkpoint to the HF
+# ecosystem (generate/evaluate/serve with transformers). Same mechanical
+# mapping, still no transposes.
+
+def hf_config_from_gpt(cfg, vocab_size: int | None = None):
+    """HF GPT2Config mirroring our GPTConfig. vocab_size crops the export
+    (e.g. 50304 MXU-padded -> 50257 real GPT-2 entries)."""
+    from transformers import GPT2Config
+
+    v = vocab_size or cfg.vocab_size
+    if v > cfg.vocab_size:
+        raise ValueError(f"export vocab_size {v} exceeds model vocab "
+                         f"{cfg.vocab_size}")
+    return GPT2Config(
+        vocab_size=v, n_positions=cfg.block_size, n_embd=cfg.n_embd,
+        n_layer=cfg.n_layer, n_head=cfg.n_head,
+        activation_function="gelu_new", layer_norm_epsilon=1e-5)
+
+
+def hf_state_dict_from_params(params: dict, n_layer: int,
+                              vocab_size: int) -> dict:
+    """Our pytree -> HF GPT2LMHeadModel state_dict (torch fp32 tensors).
+
+    bias=False checkpoints (the default config) export ZERO bias tensors:
+    the HF format requires them, and zeros are mathematically identical
+    to the bias-free forward."""
+    import torch
+
+    def t(arr) -> "torch.Tensor":
+        return torch.from_numpy(np.array(arr, np.float32, copy=True))
+
+    def dense(node, name, out_features):
+        k = t(node["kernel"])
+        b = t(node["bias"]) if "bias" in node else torch.zeros(out_features)
+        return {f"{name}.weight": k, f"{name}.bias": b}
+
+    def ln(node, name, width):
+        return {f"{name}.weight": t(node["scale"]),
+                f"{name}.bias": (t(node["bias"]) if "bias" in node
+                                 else torch.zeros(width))}
+
+    wte = t(params["wte"]["embedding"])[:vocab_size]
+    C = wte.shape[1]
+    sd = {"transformer.wte.weight": wte,
+          "transformer.wpe.weight": t(params["wpe"]["embedding"]),
+          "lm_head.weight": wte,  # weight-tied, same as training
+          **{f"transformer.{k}": v
+             for k, v in ln(params["ln_f"], "ln_f", C).items()}}
+    for i in range(n_layer):
+        p = params[f"h_{i}"]
+        layer = {**ln(p["ln_1"], "ln_1", C), **ln(p["ln_2"], "ln_2", C),
+                 **dense(p["attn"]["c_attn"], "attn.c_attn", 3 * C),
+                 **dense(p["attn"]["c_proj"], "attn.c_proj", C),
+                 **dense(p["mlp"]["c_fc"], "mlp.c_fc", 4 * C),
+                 **dense(p["mlp"]["c_proj"], "mlp.c_proj", C)}
+        sd.update({f"transformer.h.{i}.{k}": v for k, v in layer.items()})
+    return sd
+
+
+def export_hf_gpt2(params: dict, cfg, out_dir: str,
+                   vocab_size: int | None = None) -> str:
+    """Write an HF save_pretrained directory loadable by
+    GPT2LMHeadModel.from_pretrained (and by this repo's own
+    `--init_from=hf:<dir>`, which is the offline round-trip test)."""
+    from transformers import GPT2LMHeadModel
+
+    hf_cfg = hf_config_from_gpt(cfg, vocab_size)
+    sd = hf_state_dict_from_params(params, cfg.n_layer, hf_cfg.vocab_size)
+    model = GPT2LMHeadModel(hf_cfg)
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    # strict=False only to tolerate version-dependent non-persistent
+    # buffers (per-layer causal masks); real weights must all match.
+    bad = [m for m in missing if not m.endswith((".attn.bias",
+                                                 ".attn.masked_bias"))]
+    if bad or unexpected:
+        raise ValueError(f"state_dict mismatch: missing={bad} "
+                         f"unexpected={list(unexpected)}")
+    model.save_pretrained(out_dir)
+    return out_dir
+
+
+def main(argv: list[str] | None = None) -> str:
+    """CLI: export a trained checkpoint to an HF directory.
+
+        python -m nanosandbox_tpu.models.convert \
+            --out_dir=runs/gpt2_124m --to=exports/gpt2_124m_hf \
+            [--vocab_size=50257] [--step=N]
+    """
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--out_dir", required=True,
+                    help="training out_dir holding ckpt/")
+    ap.add_argument("--to", required=True, help="destination HF directory")
+    ap.add_argument("--vocab_size", type=int, default=None,
+                    help="crop the exported vocab (e.g. 50257 from a "
+                         "50304 MXU-padded table)")
+    ap.add_argument("--step", type=int, default=None)
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    import orbax.checkpoint as ocp
+
+    from nanosandbox_tpu.checkpoint import Checkpointer
+    from nanosandbox_tpu.config import GPTConfig, TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    ckpt = Checkpointer(args.out_dir)
+    step = args.step if args.step is not None else ckpt.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {args.out_dir}/ckpt")
+    restored = ckpt.mgr.restore(
+        step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+    import jax
+
+    cfg = TrainConfig(**{**restored["extra"]["config"], "device": "cpu",
+                         "init_from": "resume", "out_dir": args.out_dir,
+                         "mesh_dp": -1, "mesh_fsdp": 1, "mesh_tp": 1,
+                         "mesh_sp": 1, "shard_params": False,
+                         "attention_impl": "xla",
+                         # Export never builds a batch; any mesh-divisible
+                         # value satisfies the Trainer's fail-fast checks.
+                         "batch_size": len(jax.devices()),
+                         "gradient_accumulation_steps": 1})
+    trainer = Trainer(cfg)
+    state, _ = ckpt.restore(trainer.abstract_state, step)
+    dest = export_hf_gpt2(state["params"], trainer.model_cfg, args.to,
+                          vocab_size=args.vocab_size)
+    print(f"exported step {step} -> {dest}")
+    return dest
+
+
+if __name__ == "__main__":
+    main()

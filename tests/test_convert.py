@@ -147,3 +147,78 @@ def test_empty_hf_path_is_not_pretrained(char_dataset, tmp_path):
     trainer = Trainer(cfg)
     assert trainer._pretrained is False
     assert trainer.model_cfg.n_layer == 1  # user dims kept
+
+
+def test_export_roundtrip_logits_parity(tmp_path):
+    """Our params -> export_hf_gpt2 -> GPT2LMHeadModel.from_pretrained:
+    torch forward must reproduce our logits. Covers the bias=True path
+    (import-shaped params) AND the vocab-crop."""
+    from nanosandbox_tpu.models.convert import export_hf_gpt2
+    from transformers import GPT2LMHeadModel
+
+    hf = _hf_model(vocab=128)
+    cfg = gpt_config_from_hf(hf.config, compute_dtype="float32")
+    params = params_from_hf_state_dict(hf.state_dict(), cfg.n_layer)
+
+    dest = export_hf_gpt2(params, cfg, str(tmp_path / "hf"), vocab_size=120)
+    back = GPT2LMHeadModel.from_pretrained(dest).eval()
+    assert back.config.vocab_size == 120
+
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 120, size=(2, 32))
+    with torch.no_grad():
+        theirs = back(torch.from_numpy(x)).logits.numpy()
+    ours = GPT(cfg).apply({"params": params}, jnp.asarray(x, jnp.int32))
+    np.testing.assert_allclose(np.asarray(ours)[..., :120], theirs,
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_export_biasfree_checkpoint(tmp_path):
+    """The DEFAULT config trains bias=False; export writes zero biases
+    (mathematically identical) and the HF model still reproduces logits."""
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.convert import export_hf_gpt2
+    from transformers import GPT2LMHeadModel
+
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=64,
+                    vocab_size=128, bias=False, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    dest = export_hf_gpt2(params, cfg, str(tmp_path / "hf"))
+    back = GPT2LMHeadModel.from_pretrained(dest).eval()
+
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 128, size=(2, 32))
+    with torch.no_grad():
+        theirs = back(torch.from_numpy(x)).logits.numpy()
+    ours = model.apply({"params": params}, jnp.asarray(x, jnp.int32))
+    np.testing.assert_allclose(np.asarray(ours), theirs,
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_export_cli_from_checkpoint(char_dataset, tmp_path):
+    """End to end: train 2 iters -> checkpoint -> module CLI -> HF dir ->
+    re-import through our own `hf:` path (the fully-offline round trip)."""
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.models import convert as convert_mod
+    from nanosandbox_tpu.train import Trainer
+
+    out = str(tmp_path / "run")
+    cfg = TrainConfig(
+        out_dir=out, data_dir=char_dataset, dataset="shakespeare_char",
+        n_layer=2, n_head=2, n_embd=64, block_size=64, batch_size=8,
+        max_iters=2, eval_interval=0, eval_iters=2, log_interval=1,
+        warmup_iters=1, lr_decay_iters=2, compute_dtype="float32",
+        tensorboard=False, device="cpu")
+    Trainer(cfg).run()
+
+    dest = convert_mod.main(["--out_dir", out, "--to",
+                             str(tmp_path / "hf_export")])
+    cfg2, params2 = __import__(
+        "nanosandbox_tpu.models.convert", fromlist=["load_hf_gpt2"]
+    ).load_hf_gpt2(dest)
+    assert cfg2.n_layer == 2 and cfg2.n_embd == 64
+    assert params2["wte"]["embedding"].shape[0] == cfg2.vocab_size
