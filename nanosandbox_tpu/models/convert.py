@@ -237,11 +237,18 @@ def main(argv: list[str] | None = None) -> str:
     ap.add_argument("--step", type=int, default=None)
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
+    from nanosandbox_tpu.train import Trainer, _select_platform
+
+    # Force CPU BEFORE anything initializes a jax backend: export runs at
+    # checkpoint-handling speed and must not contend for (or crash on) a
+    # TPU a training job already holds — len(jax.devices()) below would
+    # otherwise be the very call that grabs the accelerator.
+    _select_platform("cpu")
+
     import orbax.checkpoint as ocp
 
     from nanosandbox_tpu.checkpoint import Checkpointer
     from nanosandbox_tpu.config import GPTConfig, TrainConfig
-    from nanosandbox_tpu.train import Trainer
 
     ckpt = Checkpointer(args.out_dir)
     step = args.step if args.step is not None else ckpt.latest_step()

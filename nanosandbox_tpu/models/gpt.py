@@ -235,6 +235,10 @@ class GPT(nn.Module):
         x = x.astype(cfg.compute_dtype)
 
         if cache is not None:
+            if return_hidden:
+                raise ValueError(
+                    "return_hidden is a training-loss hook (chunked CE); "
+                    "the cached decode path always returns (logits, cache)")
             # Decode path: no remat (inference has no backward to feed).
             new_cache = []
             for i in range(cfg.n_layer):

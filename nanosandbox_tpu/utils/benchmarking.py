@@ -56,4 +56,8 @@ def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
         "mfu": round(trainer.flops_per_iter() / step_s
                      / trainer.peak_flops(), 4),
         "loss": round(loss, 4),
+        # Provenance: the value the measured Trainer ACTUALLY resolved
+        # (auto chunk depends on per-device batch/mesh — reporting it from
+        # the source keeps sweep artifacts honest, perf_sweep autoconfig).
+        "resolved_loss_chunk_size": trainer.loss_chunk_size,
     }

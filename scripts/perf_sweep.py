@@ -114,22 +114,15 @@ def main(argv: list[str]) -> list[dict]:
         cfg_path = kv.get("config")
         if not cfg_path:
             raise SystemExit("--mode=autoconfig requires --config=<file.py>")
-        from nanosandbox_tpu.config import load_config, resolve_loss_chunk_size
+        from nanosandbox_tpu.config import load_config
 
         user = load_config([cfg_path])
-        # Mirror the Trainer's resolution EXACTLY (train.py:163): per-DEVICE
-        # batch over the data*fsdp shards of the mesh this host will build,
-        # and the config's seq axis — not the global batch with no mesh.
-        claimed = user.mesh_fsdp * user.mesh_tp * user.mesh_sp
-        dp = (n_chips // claimed if user.mesh_dp == -1 else user.mesh_dp)
-        dp_shards = max(1, dp * user.mesh_fsdp)
+        # resolved_loss_chunk_size is reported by measure_train_throughput
+        # from the Trainer that actually runs — never recomputed here,
+        # which would silently desync from train.py's resolution.
         point = {"mode": "autoconfig", "config": os.path.basename(cfg_path),
                  "attention_impl": user.attention_impl,
                  "loss_chunk_size": user.loss_chunk_size,
-                 "resolved_loss_chunk_size": resolve_loss_chunk_size(
-                     user.loss_chunk_size, user.batch_size // dp_shards,
-                     user.block_size, user.vocab_size or 50304,
-                     seq_shards=user.mesh_sp),
                  "remat": user.remat, "batch_size": user.batch_size}
         cfg = user.replace(
             out_dir=os.path.join(tmp, "out"), data_dir=data_dir,

@@ -110,3 +110,13 @@ def test_init_cache_rejects_beyond_block_size():
     import pytest
     with pytest.raises(ValueError, match="block_size"):
         init_cache(cfg, 1, 17)
+
+
+def test_cache_and_return_hidden_conflict_raises():
+    cfg, model, params = _tiny_model()
+    cache = init_cache(cfg, 1, 8)
+    import pytest
+    with pytest.raises(ValueError, match="return_hidden"):
+        model.apply({"params": params}, jnp.zeros((1, 4), jnp.int32),
+                    deterministic=True, return_hidden=True,
+                    cache=cache, cache_index=0)
