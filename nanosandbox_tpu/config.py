@@ -117,6 +117,11 @@ class TrainConfig:
     num_processes: int = 0
     process_id: int = -1
 
+    # Print XLA's compile-time memory breakdown of the train step before
+    # training (params/state/temp/total bytes per device) — the "will it
+    # fit HBM" preflight. Costs one extra AOT compile, hence opt-in.
+    memory_report: bool = False
+
     # -- logging --
     tensorboard: bool = True
     run_name: str = ""

@@ -370,6 +370,41 @@ class Trainer:
                 self._eval_step = self._eval_step_fn
         return self._train_step, self._eval_step
 
+    def memory_report(self) -> dict:
+        """XLA's compile-time memory analysis of the train step — the
+        'will this config fit HBM?' answer without burning a step (the
+        760M/1.5B configs live or die by this, BASELINE.md scaling notes).
+
+        AOT-lowers on abstract inputs; costs one extra compile, which is
+        why it sits behind --memory_report instead of running always.
+        Keys are bytes, per device."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.cfg.compile:
+            raise ValueError("memory_report requires compile=True")
+        train_step, _ = self.compiled_steps()
+        rows = self.cfg.sequences_per_iter
+        batch_sds = jax.ShapeDtypeStruct((rows, self.cfg.block_size),
+                                         jnp.int32,
+                                         sharding=self.batch_sharding)
+        ma = train_step.lower(self.abstract_state, batch_sds, batch_sds,
+                              jax.random.key(0)).compile().memory_analysis()
+        if ma is None:  # backend without memory analysis
+            return {}
+        self.flops_per_iter()  # populates self._n_params
+        return {
+            "params_bytes": 4 * self._n_params,
+            "state_bytes": ma.argument_size_in_bytes,   # params+opt+batch
+            "temp_bytes": ma.temp_size_in_bytes,        # activations/workspace
+            "output_bytes": ma.output_size_in_bytes,
+            "code_bytes": ma.generated_code_size_in_bytes,
+            "total_bytes": (ma.argument_size_in_bytes
+                            + ma.temp_size_in_bytes
+                            + ma.output_size_in_bytes
+                            + ma.generated_code_size_in_bytes),
+        }
+
     # -- data ----------------------------------------------------------------
 
     def make_loader(self, split: str, start_step: int = 0, prefetch=True):
@@ -478,6 +513,18 @@ class Trainer:
         writer = MetricsWriter(cfg.resolved_log_dir, cfg.run_name,
                                enabled=self.is_main,
                                tensorboard=cfg.tensorboard)
+        if cfg.memory_report and cfg.compile:
+            mem = self.memory_report()
+            if mem and self.is_main:
+                gb = 1 << 30
+                print(f"memory report (per device): params "
+                      f"{mem['params_bytes'] / gb:.2f} GB, state+batch "
+                      f"{mem['state_bytes'] / gb:.2f} GB, activations/temp "
+                      f"{mem['temp_bytes'] / gb:.2f} GB, total "
+                      f"{mem['total_bytes'] / gb:.2f} GB")
+            if mem:
+                writer.log(0, {f"mem/{k}": float(v)
+                               for k, v in mem.items()})
         loader = self.make_loader("train", start_step=iter_num)
         rng = jax.random.key(cfg.seed + 7)
 

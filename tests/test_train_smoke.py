@@ -98,3 +98,25 @@ def test_eval_batch_divisibility_validated(tiny_cfg, monkeypatch):
     cfg = tiny_cfg.replace(batch_size=8, gradient_accumulation_steps=2)
     with pytest.raises(ValueError, match="num_processes"):
         Trainer(cfg)
+
+
+def test_memory_report(char_dataset, tmp_path):
+    """--memory_report: XLA's compile-time breakdown is exposed with sane
+    invariants (state >= params; total covers the parts)."""
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = TrainConfig(
+        out_dir=str(tmp_path / "o"), data_dir=char_dataset,
+        dataset="shakespeare_char", n_layer=2, n_head=2, n_embd=64,
+        block_size=64, batch_size=8, max_iters=1, eval_interval=0,
+        warmup_iters=1, lr_decay_iters=1, compute_dtype="float32",
+        tensorboard=False, device="cpu")
+    trainer = Trainer(cfg)
+    mem = trainer.memory_report()
+    if not mem:
+        return  # backend without memory analysis
+    assert mem["params_bytes"] > 0
+    # params (f32) + Adam m/v (2x) + batch live in the argument set.
+    assert mem["state_bytes"] >= 3 * mem["params_bytes"]
+    assert mem["total_bytes"] >= mem["state_bytes"] + mem["temp_bytes"]
