@@ -10,6 +10,11 @@
 #      corpus, where the unregularized run's val curve knees into
 #      memorization at ~9 epochs (best val 3.052 @ 2500).
 out_dir = "runs_r4/gpt2_124m_englishprose_bpe_dropout"
+# Hardware RNG for the dropout mask stream: threefry mask generation is
+# ~half the e2e cost of dropout>0 configs on TPU (A/B in BASELINE.md —
+# 93.5k vs 85.7k tok/s at this exact shape); same statistics, different
+# bits, so only the mask realization changes.
+rng_impl = "rbg"
 dataset = "english_prose_bpe"
 vocab_size = 50304  # dataset meta says 50257; padded to 64 for the MXU
 n_layer = 12

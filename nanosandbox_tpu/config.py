@@ -70,6 +70,13 @@ class TrainConfig:
     device: str = "auto"  # 'auto' | 'cpu' | 'tpu' (ref: --device={cpu,cuda})
     compile: bool = True  # jax.jit the train step (ref: --compile)
     seed: int = 1337
+    # PRNG impl for the TRAINING rng stream (dropout masks). 'threefry2x32'
+    # is jax's default (counter-based, splittable, slow on TPU — ~half
+    # the e2e cost of dropout>0 configs is mask generation); 'rbg' uses
+    # the hardware RNG path (the T5X/MaxText production choice). Same
+    # statistics, different bits; loss trajectories under dropout differ
+    # by mask realization only.
+    rng_impl: str = "threefry2x32"
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"  # MXU-native
     attention_impl: str = "auto"  # 'auto' | 'pallas' | 'xla' | 'ring'

@@ -305,6 +305,15 @@ class Trainer:
                                   deterministic=deterministic, **kwargs)
         return cross_entropy_loss(logits, y)
 
+    def train_rng(self, seed: int):
+        """Root key of the TRAINING rng stream (dropout masks), honoring
+        cfg.rng_impl. Init/eval keys stay on the default impl — they are
+        not per-step costs and their determinism contract predates the
+        knob."""
+        import jax
+
+        return jax.random.key(seed, impl=self.cfg.rng_impl)
+
     def _train_step_fn(self, state, x, y, rng):
         import jax
         import jax.numpy as jnp
@@ -389,7 +398,7 @@ class Trainer:
                                          jnp.int32,
                                          sharding=self.batch_sharding)
         ma = train_step.lower(self.abstract_state, batch_sds, batch_sds,
-                              jax.random.key(0)).compile().memory_analysis()
+                              self.train_rng(0)).compile().memory_analysis()
         if ma is None:  # backend without memory analysis
             return {}
         self.flops_per_iter()  # populates self._n_params
@@ -526,7 +535,7 @@ class Trainer:
                 writer.log(0, {f"mem/{k}": float(v)
                                for k, v in mem.items()})
         loader = self.make_loader("train", start_step=iter_num)
-        rng = jax.random.key(cfg.seed + 7)
+        rng = self.train_rng(cfg.seed + 7)
 
         tokens_per_iter = cfg.tokens_per_iter
         flops_per_iter = self.flops_per_iter()

@@ -29,7 +29,7 @@ def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
     state = trainer.init_state()
     train_step, _ = trainer.compiled_steps()
     loader = trainer.make_loader("train", prefetch=True)
-    rng = jax.random.key(0)
+    rng = trainer.train_rng(0)
     try:
         for _ in range(warmup):
             xb, yb = next(loader)

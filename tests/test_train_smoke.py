@@ -120,3 +120,23 @@ def test_memory_report(char_dataset, tmp_path):
     # params (f32) + Adam m/v (2x) + batch live in the argument set.
     assert mem["state_bytes"] >= 3 * mem["params_bytes"]
     assert mem["total_bytes"] >= mem["state_bytes"] + mem["temp_bytes"]
+
+
+def test_rng_impl_rbg_trains(char_dataset, tmp_path):
+    """rng_impl='rbg' (the TPU-fast dropout-mask stream) composes with the
+    full train step + dropout; loss falls as with the default impl."""
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.train import Trainer
+
+    cfg = TrainConfig(
+        out_dir=str(tmp_path / "o"), data_dir=char_dataset,
+        dataset="shakespeare_char", n_layer=2, n_head=2, n_embd=64,
+        block_size=64, batch_size=8, max_iters=8, eval_interval=0,
+        log_interval=1, warmup_iters=1, lr_decay_iters=8, dropout=0.2,
+        rng_impl="rbg", compute_dtype="float32", tensorboard=False,
+        device="cpu")
+    trainer = Trainer(cfg)
+    import jax
+    assert str(jax.random.key_impl(trainer.train_rng(0))) == "rbg"
+    result = trainer.run()
+    assert result["final_loss"] < 3.5
