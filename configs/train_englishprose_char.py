@@ -11,6 +11,9 @@ n_embd = 384
 block_size = 256
 batch_size = 64
 dropout = 0.2
+# Hardware-RNG dropout masks: threefry mask generation costs ~17% at
+# this shape (BASELINE.md rng A/B: 733.7k vs 629.0k tok/s).
+rng_impl = "rbg"
 max_iters = 5000
 lr_decay_iters = 5000
 eval_interval = 250
