@@ -237,38 +237,13 @@ def main(argv: list[str] | None = None) -> str:
     ap.add_argument("--step", type=int, default=None)
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
-    from nanosandbox_tpu.train import Trainer, _select_platform
+    from nanosandbox_tpu.train import restore_for_inference
 
-    # Force CPU BEFORE anything initializes a jax backend: export runs at
-    # checkpoint-handling speed and must not contend for (or crash on) a
-    # TPU a training job already holds — len(jax.devices()) below would
-    # otherwise be the very call that grabs the accelerator.
-    _select_platform("cpu")
-
-    import orbax.checkpoint as ocp
-
-    from nanosandbox_tpu.checkpoint import Checkpointer
-    from nanosandbox_tpu.config import GPTConfig, TrainConfig
-
-    ckpt = Checkpointer(args.out_dir)
-    step = args.step if args.step is not None else ckpt.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {args.out_dir}/ckpt")
-    restored = ckpt.mgr.restore(
-        step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
-    import jax
-
-    cfg = TrainConfig(**{**restored["extra"]["config"], "device": "cpu",
-                         "init_from": "resume", "out_dir": args.out_dir,
-                         "mesh_dp": -1, "mesh_fsdp": 1, "mesh_tp": 1,
-                         "mesh_sp": 1, "shard_params": False,
-                         "attention_impl": "xla",
-                         # Export never builds a batch; any mesh-divisible
-                         # value satisfies the Trainer's fail-fast checks.
-                         "batch_size": len(jax.devices()),
-                         "gradient_accumulation_steps": 1})
-    trainer = Trainer(cfg)
-    state, _ = ckpt.restore(trainer.abstract_state, step)
+    # device='cpu': export runs at checkpoint-handling speed and must not
+    # contend for a TPU a training job already holds (the helper forces
+    # the platform before any jax backend initializes).
+    trainer, state, step = restore_for_inference(
+        args.out_dir, step=args.step, device="cpu", attention_impl="xla")
     dest = export_hf_gpt2(state["params"], trainer.model_cfg, args.to,
                           vocab_size=args.vocab_size)
     print(f"exported step {step} -> {dest}")

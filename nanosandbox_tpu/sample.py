@@ -150,38 +150,14 @@ def main(argv: list[str] | None = None) -> list[str]:
     import jax
     import jax.numpy as jnp
 
-    from nanosandbox_tpu.checkpoint import Checkpointer
-    from nanosandbox_tpu.config import GPTConfig, TrainConfig
     from nanosandbox_tpu.data.loader import BinDataset
     from nanosandbox_tpu.data.tokenizer import get_tokenizer
-    from nanosandbox_tpu.models.gpt import GPT
-    from nanosandbox_tpu.train import Trainer, make_optimizer
+    from nanosandbox_tpu.train import restore_for_inference
 
-    ckpt = Checkpointer(args.out_dir)
-    step = ckpt.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {args.out_dir}/ckpt")
-    # Restore config first to rebuild the model/optimizer shapes.
-    import orbax.checkpoint as ocp
-    restored_extra = ckpt.mgr.restore(
-        step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
-    cfg = TrainConfig(**{**restored_extra["extra"]["config"],
-                         "device": "auto", "init_from": "resume",
-                         "out_dir": args.out_dir,
-                         "data_dir": args.data_dir})
-    if (cfg.attention_impl == "ring" or cfg.mesh_sp > 1
-            or cfg.mesh_fsdp > 1 or cfg.mesh_tp > 1):
-        # Decode is short-sequence and runs on whatever host invokes it:
-        # drop all training-time model/sequence parallelism — Orbax restores
-        # checkpoints onto any mesh, and a pure-DP mesh always fits.
-        cfg = cfg.replace(attention_impl="auto" if cfg.attention_impl == "ring"
-                          else cfg.attention_impl,
-                          mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1,
-                          shard_params=False)
-    trainer = Trainer(cfg)
-    state, _ = ckpt.restore(trainer.abstract_state, step)
-    params = state["params"]
-    params = cast_params_for_serving(params, cfg.compute_dtype)
+    trainer, state, _ = restore_for_inference(args.out_dir,
+                                              data_dir=args.data_dir)
+    cfg = trainer.cfg
+    params = cast_params_for_serving(state["params"], cfg.compute_dtype)
 
     ds = BinDataset(args.data_dir, args.dataset)
     meta = ds.meta

@@ -89,6 +89,52 @@ def make_optimizer(cfg: TrainConfig):
     return tx, schedule
 
 
+def restore_for_inference(out_dir: str, *, step: int | None = None,
+                          device: str = "auto", **overrides):
+    """(trainer, state, step): rebuild a Trainer from a checkpoint's SAVED
+    config for single-host inference/conversion — the shared restore dance
+    of sample.py and models/convert.py.
+
+    Normalizations every inference consumer needs: training-time
+    model/sequence parallelism is dropped (Orbax restores any checkpoint
+    onto a pure-DP mesh, and short-sequence decode runs on whatever host
+    invokes it), and batch_size is replaced by a mesh-divisible dummy
+    (inference builds its own batches; the saved value may not divide
+    this host's device count). Caller ``overrides`` are applied last.
+    """
+    # Force the platform BEFORE jax initializes below: len(jax.devices())
+    # would otherwise be the call that grabs an accelerator a training job
+    # may already hold (the device='cpu' conversion path).
+    _select_platform(device)
+    import jax
+    import orbax.checkpoint as ocp
+
+    from nanosandbox_tpu.checkpoint import Checkpointer
+    from nanosandbox_tpu.config import TrainConfig
+
+    ckpt = Checkpointer(out_dir)
+    step = step if step is not None else ckpt.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {out_dir}/ckpt")
+    restored = ckpt.mgr.restore(
+        step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+    cfg = TrainConfig(**{**restored["extra"]["config"], "device": device,
+                         "init_from": "resume", "out_dir": out_dir})
+    if (cfg.attention_impl == "ring" or cfg.mesh_sp > 1
+            or cfg.mesh_fsdp > 1 or cfg.mesh_tp > 1):
+        cfg = cfg.replace(
+            attention_impl="auto" if cfg.attention_impl == "ring"
+            else cfg.attention_impl,
+            mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1,
+            shard_params=False)
+    cfg = cfg.replace(batch_size=len(jax.devices()),
+                      gradient_accumulation_steps=1, **overrides)
+    trainer = Trainer(cfg)
+    state, _ = ckpt.restore(trainer.abstract_state, step)
+    ckpt.close()
+    return trainer, state, step
+
+
 class Trainer:
     """Owns model/optimizer/state/mesh and the compiled step functions."""
 
@@ -522,6 +568,8 @@ class Trainer:
         writer = MetricsWriter(cfg.resolved_log_dir, cfg.run_name,
                                enabled=self.is_main,
                                tensorboard=cfg.tensorboard)
+        if cfg.memory_report and not cfg.compile and self.is_main:
+            print("memory_report skipped: requires compile=True")
         if cfg.memory_report and cfg.compile:
             mem = self.memory_report()
             if mem and self.is_main:

@@ -76,6 +76,18 @@ def main(argv: list[str]) -> list[dict]:
 
     results = []
 
+    def record(point, cfg):
+        """Measure cfg, merge into the point dict, stream + collect it —
+        errors become recorded rows, never crashes (the tunnel's
+        remote-compile 500s land here)."""
+        try:
+            point.update(measure_train_throughput(cfg, warmup, iters))
+        except Exception as e:
+            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        print(json.dumps(point), flush=True)
+        results.append(point)
+        return point
+
     def run_point(**overrides):
         # batch_size values are PER-CHIP (same semantics as bench.py, so
         # sweep points stay comparable to bench output on any host size);
@@ -87,13 +99,7 @@ def main(argv: list[str]) -> list[dict]:
                              batch_size=overrides["batch_size"] * n_chips)
         cfg = base.replace(**overrides)
         point["global_batch_size"] = cfg.batch_size
-        try:
-            point.update(measure_train_throughput(cfg, warmup, iters))
-        except Exception as e:
-            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
-        print(json.dumps(point), flush=True)
-        results.append(point)
-        return point
+        return record(point, cfg)
 
     mode = kv.get("mode", "")
     if mode and full:
@@ -129,12 +135,7 @@ def main(argv: list[str]) -> list[dict]:
             dataset="shakespeare_char", vocab_size=user.vocab_size or 50304,
             max_iters=0, eval_interval=0, tensorboard=False,
             profile_steps="", init_from="scratch")
-        try:
-            point.update(measure_train_throughput(cfg, warmup, iters))
-        except Exception as e:
-            point["error"] = f"{type(e).__name__}: {str(e)[:200]}"
-        print(json.dumps(point), flush=True)
-        results.append(point)
+        record(point, cfg)
     elif mode == "statlayout":
         # A/B the flash-backward stat-operand layout (r3 VERDICT next #6):
         # 'compact' cuts ~128x of lane-replicated stat HBM traffic at the
