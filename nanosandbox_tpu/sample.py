@@ -18,10 +18,13 @@ import sys
 from functools import partial
 
 
-def _sample_token(logits_i, rng, *, temperature: float, top_k: int):
+def _sample_token(logits_i, rng, *, temperature: float, top_k: int,
+                  top_p: float = 1.0):
     """One sampling decision from (B, V) logits. temperature=0 is greedy
     (argmax, no RNG consumed) — torch's convention and the determinism
-    anchor for the cached-vs-windowed parity tests."""
+    anchor for the cached-vs-windowed parity tests. top_k and top_p
+    (nucleus) compose: k-truncation first, then the smallest probability
+    mass >= top_p survives."""
     import jax
     import jax.numpy as jnp
 
@@ -36,12 +39,26 @@ def _sample_token(logits_i, rng, *, temperature: float, top_k: int):
         # per-token matmul work.
         kth = jax.lax.top_k(logits_i, k)[0][:, -1][:, None]
         logits_i = jnp.where(logits_i < kth, -1e30, logits_i)
+    if top_p < 1.0:
+        # Nucleus filter: drop tokens outside the smallest set whose
+        # probability mass reaches top_p. Sorted once (descending); a
+        # token survives if the mass BEFORE it is still < top_p (keeps
+        # at least the top-1 token by construction).
+        sort_idx = jnp.argsort(-logits_i, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits_i, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = mass_before < top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(keep_sorted.shape[0])[:, None], sort_idx
+        ].set(keep_sorted)
+        logits_i = jnp.where(keep, logits_i, -1e30)
     rng, sub = jax.random.split(rng)
     return jax.random.categorical(sub, logits_i).astype(jnp.int32), rng
 
 
 def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
-             top_k: int, rng, block_size: int):
+             top_k: int, rng, block_size: int, top_p: float = 1.0):
     """KV-cached decode: one prefill over the prompt, then a lax.scan whose
     step runs the model on a SINGLE token against per-layer (B, H, total, D)
     cache buffers (models/gpt.py cache path). Attention reads grow with the
@@ -64,13 +81,13 @@ def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
     if total > block_size:
         return _generate_windowed(model, params, idx, max_new_tokens,
                                   temperature=temperature, top_k=top_k,
-                                  rng=rng, block_size=block_size)
+                                  rng=rng, block_size=block_size, top_p=top_p)
 
     cache = init_cache(model.cfg, B, total)
     logits, cache = model.apply({"params": params}, idx, deterministic=True,
                                 cache=cache, cache_index=0)
     nxt, rng = _sample_token(logits[:, -1, :], rng,
-                             temperature=temperature, top_k=top_k)
+                             temperature=temperature, top_k=top_k, top_p=top_p)
 
     def step(carry, i):
         tok, cache, rng = carry
@@ -78,7 +95,8 @@ def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
                                     deterministic=True,
                                     cache=cache, cache_index=i)
         nxt, rng = _sample_token(logits[:, 0, :], rng,
-                                 temperature=temperature, top_k=top_k)
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
         return (nxt, cache, rng), tok
 
     (last, _, _), ys = lax.scan(step, (nxt, cache, rng),
@@ -102,7 +120,8 @@ def cast_params_for_serving(params, compute_dtype):
 
 
 def _generate_windowed(model, params, idx, max_new_tokens: int, *,
-                       temperature: float, top_k: int, rng, block_size: int):
+                       temperature: float, top_k: int, rng, block_size: int,
+                       top_p: float = 1.0):
     """Full-forward sliding-window decode (nanoGPT's crop-and-reforward
     semantics) — the only correct option once positions pass block_size."""
     import jax.numpy as jnp
@@ -123,7 +142,8 @@ def _generate_windowed(model, params, idx, max_new_tokens: int, *,
         pos_in_ctx = i - start
         logits_i = logits[jnp.arange(B), pos_in_ctx, :]
         nxt, rng = _sample_token(logits_i, rng,
-                                 temperature=temperature, top_k=top_k)
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
         buf = buf.at[:, i + 1].set(nxt)
         return (buf, rng), None
 
@@ -144,6 +164,8 @@ def main(argv: list[str] | None = None) -> list[str]:
     ap.add_argument("--max_new_tokens", type=int, default=200)
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top_k", type=int, default=40)
+    ap.add_argument("--top_p", type=float, default=1.0,
+                    help="nucleus sampling mass (1.0 disables)")
     ap.add_argument("--seed", type=int, default=1337)
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
@@ -169,7 +191,7 @@ def main(argv: list[str] | None = None) -> list[str]:
     gen = jax.jit(partial(generate, trainer.model,
                           max_new_tokens=args.max_new_tokens,
                           temperature=args.temperature, top_k=args.top_k,
-                          block_size=cfg.block_size))
+                          top_p=args.top_p, block_size=cfg.block_size))
     out = gen(params, idx, rng=rng)
     texts = []
     for row in out:

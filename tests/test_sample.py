@@ -120,3 +120,36 @@ def test_cache_and_return_hidden_conflict_raises():
         model.apply({"params": params}, jnp.zeros((1, 4), jnp.int32),
                     deterministic=True, return_hidden=True,
                     cache=cache, cache_index=0)
+
+
+def test_top_p_nucleus_filter():
+    """top_p keeps exactly the smallest prefix of the sorted distribution
+    whose mass reaches p: probs (.5, .3, .15, .05) @ p=0.6 -> tokens
+    {0, 1} only (mass before token 2 is already 0.8)."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    probs = jnp.asarray([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    seen = set()
+    rng = jax.random.key(0)
+    for _ in range(200):
+        tok, rng = _sample_token(logits, rng, temperature=1.0, top_k=0,
+                                 top_p=0.6)
+        seen.add(int(tok[0]))
+    assert seen == {0, 1}, seen
+    # p=1.0 disables the filter: the tail tokens reappear.
+    seen = set()
+    for _ in range(400):
+        tok, rng = _sample_token(logits, rng, temperature=1.0, top_k=0,
+                                 top_p=1.0)
+        seen.add(int(tok[0]))
+    assert seen == {0, 1, 2, 3}, seen
+
+
+def test_top_p_composes_with_cached_generate():
+    cfg, model, params = _tiny_model()
+    idx = jnp.asarray([[1, 2]], jnp.int32)
+    out = generate(model, params, idx, 10, temperature=0.9, top_k=0,
+                   rng=jax.random.key(2), block_size=cfg.block_size,
+                   top_p=0.9)
+    assert out.shape == (1, 12)
