@@ -48,7 +48,10 @@ def _sample_token(logits_i, rng, *, temperature: float, top_k: int,
         sorted_logits = jnp.take_along_axis(logits_i, sort_idx, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         mass_before = jnp.cumsum(probs, axis=-1) - probs
-        keep_sorted = mass_before < top_p
+        # .at[0].set(True): mass_before[0] == 0 is not < top_p when
+        # top_p <= 0, which would mask EVERY token and turn categorical
+        # into uniform-over-vocab garbage; the top-1 token always survives.
+        keep_sorted = (mass_before < top_p).at[:, 0].set(True)
         keep = jnp.zeros_like(keep_sorted).at[
             jnp.arange(keep_sorted.shape[0])[:, None], sort_idx
         ].set(keep_sorted)

@@ -120,15 +120,16 @@ def restore_for_inference(out_dir: str, *, step: int | None = None,
         step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
     cfg = TrainConfig(**{**restored["extra"]["config"], "device": device,
                          "init_from": "resume", "out_dir": out_dir})
-    if (cfg.attention_impl == "ring" or cfg.mesh_sp > 1
-            or cfg.mesh_fsdp > 1 or cfg.mesh_tp > 1):
-        cfg = cfg.replace(
-            attention_impl="auto" if cfg.attention_impl == "ring"
-            else cfg.attention_impl,
-            mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1,
-            shard_params=False)
-    cfg = cfg.replace(batch_size=len(jax.devices()),
-                      gradient_accumulation_steps=1, **overrides)
+    # Unconditional pure-DP normalization (idempotent for already-pure-DP
+    # configs): a saved EXPLICIT mesh_dp (e.g. 8 from a v4-8 run) must not
+    # survive onto a host with a different device count any more than
+    # fsdp/sp/tp may.
+    defaults = dict(
+        attention_impl="auto" if cfg.attention_impl == "ring"
+        else cfg.attention_impl,
+        mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1, shard_params=False,
+        batch_size=len(jax.devices()), gradient_accumulation_steps=1)
+    cfg = cfg.replace(**{**defaults, **overrides})
     trainer = Trainer(cfg)
     state, _ = ckpt.restore(trainer.abstract_state, step)
     ckpt.close()
@@ -448,16 +449,22 @@ class Trainer:
         if ma is None:  # backend without memory analysis
             return {}
         self.flops_per_iter()  # populates self._n_params
+        itemsize = jnp.dtype(self.cfg.param_dtype).itemsize
         return {
-            "params_bytes": 4 * self._n_params,
+            "params_bytes": itemsize * self._n_params,
             "state_bytes": ma.argument_size_in_bytes,   # params+opt+batch
             "temp_bytes": ma.temp_size_in_bytes,        # activations/workspace
             "output_bytes": ma.output_size_in_bytes,
             "code_bytes": ma.generated_code_size_in_bytes,
+            # alias_size: the donated train state appears in BOTH argument
+            # and output sizes (donate_argnums=(0,)); the aliased bytes
+            # occupy HBM once, so subtract them or the preflight would
+            # overstate by the whole params+opt footprint.
             "total_bytes": (ma.argument_size_in_bytes
                             + ma.temp_size_in_bytes
                             + ma.output_size_in_bytes
-                            + ma.generated_code_size_in_bytes),
+                            + ma.generated_code_size_in_bytes
+                            - ma.alias_size_in_bytes),
         }
 
     # -- data ----------------------------------------------------------------

@@ -153,3 +153,16 @@ def test_top_p_composes_with_cached_generate():
                    rng=jax.random.key(2), block_size=cfg.block_size,
                    top_p=0.9)
     assert out.shape == (1, 12)
+
+
+def test_top_p_zero_keeps_top1():
+    """top_p<=0 must degrade to near-greedy (top-1 survives), never to the
+    all-masked uniform-categorical failure mode."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    rng = jax.random.key(1)
+    for _ in range(50):
+        tok, rng = _sample_token(logits, rng, temperature=1.0, top_k=0,
+                                 top_p=0.0)
+        assert int(tok[0]) == 0
