@@ -162,21 +162,27 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     head_dim = q_ref.shape[2]
 
     if causal:
-        # Only iterate k blocks at or before this q block's frontier.
+        # Only iterate k blocks at or before this q block's frontier, and
+        # split the walk at the diagonal: blocks strictly below it need no
+        # causal mask, so the iota/compare/select VPU work (a real cost —
+        # the per-tile matmuls are tiny at head_dim 64, leaving the kernel
+        # VPU-bound) only runs on the block(s) the frontier crosses.
         num_kb = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        num_kb_inner = lax.div(qi * block_q, block_k)  # fully-unmasked
     else:
         num_kb = seq_len // block_k
+        num_kb_inner = num_kb
 
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
-    def body(j, carry):
+    def body(j, carry, *, masked: bool):
         acc, m, l = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
         s = s * sm_scale
-        if causal:
+        if masked:
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -204,7 +210,11 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
         jnp.zeros((block_q, 1), jnp.float32),
     )
-    acc, m, l = lax.fori_loop(0, num_kb, body, init)
+    carry = lax.fori_loop(0, num_kb_inner,
+                          functools.partial(body, masked=False), init)
+    # For non-causal calls num_kb_inner == num_kb and this loop is empty.
+    acc, m, l = lax.fori_loop(num_kb_inner, num_kb,
+                              functools.partial(body, masked=True), carry)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # Per-row logsumexp, the softmax residual the flash backward needs
     # (recomputing p = exp(s - L) block-by-block instead of saving (T, T)),
@@ -216,6 +226,14 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 DEFAULT_BLOCK = 512  # measured on v5e: 512x512 runs ~2.3-3x faster than
 # 128x128 (fewer grid programs; the MXU pipeline amortizes over bigger
 # score tiles) while a 512x512 f32 score tile is only 1 MiB of VMEM.
+
+# The dKV kernel's own best blocking differs from dQ's: it is parallel
+# over KEY blocks with an inner loop over q blocks, so a WIDE block_k
+# (fewer grid programs, each amortizing the q/do/o streams) wins — r5
+# microbench on v5e at (16, 12, 1024, 64): dkv 512x1024 = 1.26 ms vs
+# 512x512 = 1.37 ms, and the combined fwd+bwd layer drops ~25% once the
+# two backward kernels stop sharing one compromise blocking.
+DKV_BLOCK_K = 1024
 
 
 def _clamp_blocks(T: int, block_q: int, block_k: int) -> tuple[int, int]:
@@ -427,17 +445,21 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
             drow = drow - lse_ref[0][:, LANES:LANES + 1]
         lse = lse_ref[0][:, :1]                      # (bq, 1) f32
     seq_len = k_ref.shape[1]
-    num_kb = (lax.div((qi + 1) * block_q + block_k - 1, block_k)
-              if causal else seq_len // block_k)
+    if causal:
+        num_kb = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        num_kb_inner = lax.div(qi * block_q, block_k)  # fully-unmasked
+    else:
+        num_kb = seq_len // block_k
+        num_kb_inner = num_kb
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 0)
 
-    def body(j, dq_acc):
+    def body(j, dq_acc, *, masked: bool):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked:
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -457,8 +479,10 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, num_kb,  body,
+    dq = lax.fori_loop(0, num_kb_inner, functools.partial(body, masked=False),
                        jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    dq = lax.fori_loop(num_kb_inner, num_kb,
+                       functools.partial(body, masked=True), dq)
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
@@ -474,11 +498,19 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
     v = v_ref[0]
     seq_len = q_ref.shape[1]
     num_qb = seq_len // block_q
-    start_qb = lax.div(ki * block_k, block_q) if causal else 0
+    if causal:
+        start_qb = lax.div(ki * block_k, block_q)
+        # q blocks at/after this index sit fully above the diagonal for
+        # every key in this block — no mask needed (see the fwd kernel's
+        # split-loop note; masking is pure VPU cost).
+        diag_end = lax.div((ki + 1) * block_k + block_q - 1, block_q)
+    else:
+        start_qb = 0
+        diag_end = 0
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
 
-    def body(i, carry):
+    def body(i, carry, *, masked: bool):
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
@@ -499,7 +531,7 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
             lse = stats[:, :1]                        # (bq, 1) f32
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked:
             q_pos = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -527,9 +559,16 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         return dk_acc, dv_acc
 
     D = k.shape[1]
-    dk, dv = lax.fori_loop(start_qb, num_qb, body,
-                           (jnp.zeros((block_k, D), jnp.float32),
-                            jnp.zeros((block_k, D), jnp.float32)))
+    init = (jnp.zeros((block_k, D), jnp.float32),
+            jnp.zeros((block_k, D), jnp.float32))
+    if causal:
+        carry = lax.fori_loop(start_qb, diag_end,
+                              functools.partial(body, masked=True), init)
+        dk, dv = lax.fori_loop(diag_end, num_qb,
+                               functools.partial(body, masked=False), carry)
+    else:
+        dk, dv = lax.fori_loop(0, num_qb,
+                               functools.partial(body, masked=False), init)
     dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -556,8 +595,11 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         raise ValueError(f"unknown attention stat_layout: {stat_layout!r} "
                          "(expected 'replicated' or 'compact')")
     block_q, block_k = _clamp_blocks(q.shape[2], block_q, block_k)
+    # The dKV kernel gets its own (wider) key blocking — see DKV_BLOCK_K.
+    dkv_block_k = _clamp_blocks(q.shape[2], block_q,
+                                max(block_k, DKV_BLOCK_K))[1]
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
-        q, k, v, block_q, block_k, causal)
+        q, k, v, block_q, max(block_k, dkv_block_k), causal)
     dof = _pad_qkv(do, do, do, block_q, block_k, causal)[0]
     of = _pad_qkv(o, o, o, block_q, block_k, causal)[0]
     # Drow is NOT built here — both kernels recompute it in-register from
@@ -615,35 +657,42 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
             dq_stats_spec,
         ],
         out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
+        # Grads leave the kernel already in the input dtype: the f32
+        # accumulators are rounded on the register->VMEM write, which
+        # halves the grad HBM writes AND deletes the XLA cast pass that a
+        # f32 out_shape forced afterwards (r5 microbench: the three
+        # (B*H, Tp, 128-padded) f32 grad tensors cost ~1 ms/layer in
+        # write+cast traffic at the 124M bench shape).
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), q.dtype),
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
         interpret=interpret,
     )(seed_arg, qf, kf, vf, of, dof, statsf)
 
-    grid_k = (B * H, Tp // block_k)
+    grid_k = (B * H, Tp // dkv_block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, sm_scale=sm_scale, causal=causal,
-                          has_dlse=has_dlse, dropout_rate=dropout_rate,
+                          block_k=dkv_block_k, sm_scale=sm_scale,
+                          causal=causal, has_dlse=has_dlse,
+                          dropout_rate=dropout_rate,
                           stat_layout=stat_layout),
         grid=grid_k,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, dkv_block_k, Dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, dkv_block_k, Dp), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
             dkv_stats_spec,
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, dkv_block_k, Dp), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, dkv_block_k, Dp), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
-            jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tp, Dp), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tp, Dp), v.dtype),
         ],
         compiler_params=None if interpret else _tpu_params(
             "parallel", "parallel"),
@@ -908,22 +957,35 @@ def _probe_locally() -> bool:
         def fwd(q, k, v):
             return flash_attention(q, k, v, True, None, False)
 
-        def loss(q, k, v):
-            return fwd(q, k, v).astype(jnp.float32).sum()
+        def make_loss(layout):
+            def loss(q, k, v):
+                return flash_attention(
+                    q, k, v, True, None, False, layout
+                ).astype(jnp.float32).sum()
+            return loss
 
-        def loss_dropout(q, k, v, seed):
-            return flash_attention_dropout(
-                q, k, v, seed, True, None, 0.1, False
-            ).astype(jnp.float32).sum()
+        def make_loss_dropout(layout):
+            def loss_dropout(q, k, v, seed):
+                return flash_attention_dropout(
+                    q, k, v, seed, True, None, 0.1, False, layout
+                ).astype(jnp.float32).sum()
+            return loss_dropout
 
         s = jax.ShapeDtypeStruct((1,), jnp.uint32)
         jax.jit(fwd).lower(x, x, x).compile()
-        jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x).compile()
-        # The dropout variant is part of the same verdict: 'auto' promises
-        # that regularized (dropout>0) configs run the flash path too, so
-        # a dropout-kernel regression must also degrade auto -> xla.
-        jax.jit(jax.grad(loss_dropout, argnums=(0, 1, 2))).lower(
-            x, x, x, s).compile()
+        # BOTH stat layouts are part of the verdict: the config default is
+        # 'compact', and 'auto' must not promise a fallback it only
+        # checked for 'replicated' (round-4 ADVICE #2 — a Mosaic
+        # regression in the compact expansion path would otherwise crash
+        # the first backward instead of degrading to XLA). The dropout
+        # variant is part of the same verdict too, in both layouts:
+        # 'auto' promises that regularized (dropout>0) configs run the
+        # flash path under whichever layout the config selects.
+        for layout in ("replicated", "compact"):
+            jax.jit(jax.grad(make_loss(layout),
+                             argnums=(0, 1, 2))).lower(x, x, x).compile()
+            jax.jit(jax.grad(make_loss_dropout(layout),
+                             argnums=(0, 1, 2))).lower(x, x, x, s).compile()
         return True
     except Exception as e:  # Mosaic lowering / compile failure
         warnings.warn(
