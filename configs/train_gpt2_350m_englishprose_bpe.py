@@ -1,0 +1,33 @@
+# GPT-2 350M (medium: 24L/16H/1024d) sustained convergence on the real
+# byte-BPE corpus — the round-5 scale-up evidence (r4 VERDICT next #7:
+# "the scale rows are 20-iter probes; nothing above 124M has ever trained
+# for real"). Unlike the probes this exercises the full Trainer.run()
+# surface at 350M: on-chip eval, Orbax checkpointing, TB/JSONL metrics,
+# and the auto-resolved loss path at the bigger width.
+#
+# Batch 8 / no remat is the measured-best single-chip 350M point
+# (benchmarks/r4/sweep_scale.json: 39.4k tok/s, 48.4% MFU vs 33.5k with
+# remat+chunk at batch 16). Dropout 0.1 because the corpus is 5.46M
+# tokens: the 124M dropout-0 twin memorized at ~9 epochs (val knee at
+# step 2500), and this run passes ~6 epochs.
+out_dir = "runs_r5/gpt2_350m_englishprose_bpe"
+rng_impl = "rbg"
+dataset = "english_prose_bpe"
+vocab_size = 50304  # dataset meta says 50257; padded to 64 for the MXU
+n_layer = 24
+n_head = 16
+n_embd = 1024
+block_size = 1024
+batch_size = 8
+gradient_accumulation_steps = 1
+dropout = 0.1
+max_iters = 4000
+lr_decay_iters = 4000
+warmup_iters = 200
+eval_interval = 250
+eval_iters = 20
+log_interval = 50
+learning_rate = 3e-4  # nanoGPT's gpt2-medium-scale LR tier
+min_lr = 3e-5
+compute_dtype = "bfloat16"
+attention_impl = "auto"

@@ -102,18 +102,62 @@ class LocalBPETokenizer:
                 "asset": self.asset}
 
 
+# Drop-in location for the REAL GPT-2 vocabulary on air-gapped hosts:
+# save HF's gpt2 tokenizer file here (e.g.
+# `GPT2TokenizerFast.from_pretrained("gpt2").save_pretrained(...)` on any
+# online machine, or copy tokenizer.json from the HF hub) and
+# get_tokenizer('gpt2') works with no network. Validated structurally on
+# load (50,257 entries, <|endoftext|> = 50256) so a wrong file cannot
+# silently tokenize into the wrong id space.
+GPT2_LOCAL_ASSET = "data/fixtures/gpt2/tokenizer.json"
+
+
 class GPT2Tokenizer:
-    """GPT-2 BPE via tiktoken (the reference's tokenizer dep, ipynb:37)."""
+    """GPT-2 BPE — tiktoken (the reference's tokenizer dep, ipynb:37)
+    when it can reach its cache/CDN, else a vendored HF tokenizer.json
+    (GPT2_LOCAL_ASSET). Both produce the canonical GPT-2 ids; encode()
+    never emits special tokens (tiktoken's encode_ordinary semantics)."""
 
     def __init__(self):
-        import tiktoken
-        self.enc = tiktoken.get_encoding("gpt2")
-        self.vocab_size = self.enc.n_vocab  # 50257
+        self._hf = None
+        try:
+            import tiktoken
+            self.enc = tiktoken.get_encoding("gpt2")
+            self.vocab_size = self.enc.n_vocab  # 50257
+            return
+        except Exception as tiktoken_err:  # offline / no cache
+            path = os.path.join(_REPO_ROOT, GPT2_LOCAL_ASSET)
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    "tiktoken gpt2 encoding unavailable (offline?) and no "
+                    f"vendored vocabulary at {path}. Either pre-populate "
+                    "the tiktoken cache, or save the real HF gpt2 "
+                    "tokenizer.json at that path (see GPT2_LOCAL_ASSET "
+                    f"docstring). tiktoken error: {tiktoken_err}"
+                ) from tiktoken_err
+        from tokenizers import Tokenizer as HFTokenizer
+
+        self._hf = HFTokenizer.from_file(path)
+        self.vocab_size = self._hf.get_vocab_size()
+        eot = self._hf.token_to_id("<|endoftext|>")
+        if self.vocab_size != 50257 or eot != 50256:
+            raise ValueError(
+                f"{path} is not the real GPT-2 vocabulary (vocab "
+                f"{self.vocab_size}, <|endoftext|> id {eot}; expected "
+                "50257 / 50256) — refusing to tokenize into a mismatched "
+                "id space")
 
     def encode(self, text: str) -> list[int]:
+        if self._hf is not None:
+            return self._hf.encode(text, add_special_tokens=False).ids
         return self.enc.encode_ordinary(text)
 
     def decode(self, ids) -> str:
+        if self._hf is not None:
+            # skip_special_tokens=False to mirror tiktoken: decode(50256)
+            # must render '<|endoftext|>' on both backends.
+            return self._hf.decode([int(i) for i in ids],
+                                   skip_special_tokens=False)
         return self.enc.decode([int(i) for i in ids])
 
     def meta(self) -> dict:
@@ -130,10 +174,5 @@ def get_tokenizer(kind: str, meta: dict | None = None) -> Tokenizer:
     if kind == "bpe":
         return LocalBPETokenizer((meta or {}).get("asset"))
     if kind == "gpt2":
-        try:
-            return GPT2Tokenizer()
-        except Exception as e:  # offline / no BPE cache
-            raise RuntimeError(
-                "tiktoken gpt2 encoding unavailable (offline?); use the byte "
-                f"tokenizer or pre-populate the tiktoken cache: {e}") from e
+        return GPT2Tokenizer()  # raises with remediation steps when offline
     raise ValueError(f"unknown tokenizer kind: {kind!r}")

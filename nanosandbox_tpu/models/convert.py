@@ -152,7 +152,14 @@ def hf_config_from_gpt(cfg, vocab_size: int | None = None):
     return GPT2Config(
         vocab_size=v, n_positions=cfg.block_size, n_embd=cfg.n_embd,
         n_layer=cfg.n_layer, n_head=cfg.n_head,
-        activation_function="gelu_new", layer_norm_epsilon=1e-5)
+        activation_function="gelu_new", layer_norm_epsilon=1e-5,
+        # Mirror the source model's dropout instead of inheriting HF's
+        # 0.1 defaults: eval-mode serving never notices, but fine-tuning
+        # the exported checkpoint in the HF stack would otherwise
+        # silently train under different regularization than the source
+        # (round-4 ADVICE #4).
+        resid_pdrop=cfg.dropout, embd_pdrop=cfg.dropout,
+        attn_pdrop=cfg.dropout)
 
 
 def hf_state_dict_from_params(params: dict, n_layer: int,

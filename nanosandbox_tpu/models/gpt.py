@@ -104,18 +104,21 @@ class CausalSelfAttention(nn.Module):
                     "attention_impl='ring' needs an active mesh — construct "
                     "the model via Trainer, or call "
                     "parallel.mesh.set_current_mesh(make_mesh(...)) first")
+            dropout_seed = None
+            ring_rate = 0.0
             if cfg.dropout > 0.0 and not deterministic:
-                # Trainer validates this at construction; guard direct
-                # model use too — the ring blocks cannot express
-                # attention-probability dropout, and silently training
-                # under different regularization than the non-ring path
-                # would skew any loss-parity comparison.
-                raise ValueError(
-                    "attention_impl='ring' does not support attention-prob "
-                    "dropout; set dropout=0 or use attention_impl='xla'")
-            y = ring_attention_sharded(q, k, v, mesh=mesh,
-                                       layout=cfg.ring_layout,
-                                       block_impl=cfg.ring_block_impl)
+                # Attention-prob dropout composes with the ring because
+                # the keep-mask is keyed on GLOBAL (q_pos, k_pos)
+                # coordinates (ops/ring_attention.py round-5) — same
+                # regularization as the non-ring flash path.
+                ring_rate = cfg.dropout
+                dropout_seed = jax.random.bits(self.make_rng("dropout"),
+                                               (1,), jnp.uint32)
+            y = ring_attention_sharded(
+                q, k, v, mesh=mesh, layout=cfg.ring_layout,
+                block_impl=cfg.ring_block_impl,
+                stat_layout=cfg.attention_stat_layout,
+                dropout_rate=ring_rate, dropout_seed=dropout_seed)
         else:
             attn_rng = None
             if cfg.dropout > 0.0 and not deterministic:
@@ -239,6 +242,19 @@ class GPT(nn.Module):
                 raise ValueError(
                     "return_hidden is a training-loss hook (chunked CE); "
                     "the cached decode path always returns (logits, cache)")
+            # Contract: cache_index + T must stay within the cache buffer.
+            # An overrun would not error — dynamic_update_slice clamps the
+            # write offset and the wpe gather clamps positions — it would
+            # silently produce wrong logits. Checkable only when the index
+            # is a Python int (jit callers pass a traced scalar and must
+            # enforce the bound themselves, as sample.generate does by
+            # falling back to the windowed path when total > block_size).
+            if isinstance(cache_index, int) and cache:
+                cache_len = cache[0][0].shape[2]
+                if cache_index + T > cache_len:
+                    raise ValueError(
+                        f"cached decode overrun: cache_index {cache_index} "
+                        f"+ T {T} exceeds the cache length {cache_len}")
             # Decode path: no remat (inference has no backward to feed).
             new_cache = []
             for i in range(cfg.n_layer):

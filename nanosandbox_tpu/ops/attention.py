@@ -49,6 +49,7 @@ LANES = 128  # minor-dim register width; row stats are replicated across it
 
 __all__ = ["causal_attention", "xla_attention", "flash_attention",
            "flash_attention_dropout", "flash_attention_lse",
+           "flash_attention_lse_dropout", "hash_dropout_keep_mask",
            "pallas_compile_probe"]
 
 
@@ -81,9 +82,28 @@ def _fmix32(h: jax.Array) -> jax.Array:
     return h
 
 
-def _dropout_tile_seed(seed_ref, bh) -> jax.Array:
-    """Per-(call, batch*head) uint32 stream key."""
-    return _fmix32(seed_ref[0] ^ (bh.astype(jnp.uint32) * jnp.uint32(_GOLDEN)))
+# The seed operand is a (5,) uint32 vector so the mask can be keyed on
+# GLOBAL coordinates under sequence/tensor parallelism (ring attention —
+# each ring step sees a different slice of the global score matrix, and
+# sharded batches/heads must draw distinct streams):
+#   [0] per-call seed   [1] global batch offset of row 0
+#   [2] global head offset of head 0   [3] global q position of row 0
+#   [4] global k position of col 0
+# All zeros for the plain (non-ring) path, which makes the stream id
+# reduce to the local bh index — bit-identical to the pre-ring masks.
+SEED_WORDS = 5
+
+
+def _dropout_tile_seed(seed_ref, bh, local_heads: int,
+                       hash_heads: int) -> jax.Array:
+    """Per-(call, GLOBAL batch*head) uint32 stream key. local_heads is the
+    head count of this kernel call's arrays; hash_heads the global head
+    count the stream id is linearized over (equal when not head-sharded)."""
+    bh = bh.astype(jnp.uint32)
+    b = bh // jnp.uint32(local_heads) + seed_ref[1]
+    h = bh % jnp.uint32(local_heads) + seed_ref[2]
+    gbh = b * jnp.uint32(hash_heads) + h
+    return _fmix32(seed_ref[0] ^ (gbh * jnp.uint32(_GOLDEN)))
 
 
 def _dropout_keep(mix: jax.Array, q_start, k_start, shape: tuple[int, int],
@@ -149,10 +169,15 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       block_q: int, block_k: int, sm_scale: float,
-                      causal: bool, dropout_rate: float = 0.0):
+                      causal: bool, dropout_rate: float = 0.0,
+                      local_heads: int = 1, hash_heads: int = 1,
+                      hash_seq_len: int = 0):
     qi = pl.program_id(1)
     if dropout_rate > 0.0:
-        mix = _dropout_tile_seed(seed_ref, pl.program_id(0))
+        mix = _dropout_tile_seed(seed_ref, pl.program_id(0),
+                                 local_heads, hash_heads)
+        q_off = seed_ref[3].astype(jnp.int32)
+        k_off = seed_ref[4].astype(jnp.int32)
     # Keep MXU inputs in their storage dtype (bf16 on TPU) with float32
     # ACCUMULATION — pre-casting to f32 would run the matmuls at the MXU's
     # f32 rate, ~8x slower. Scores are scaled in f32 after the dot instead
@@ -195,8 +220,10 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         # only the p@v accumulation implements exactly that.
         l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(mix, qi * block_q, j * block_k,
-                                 (block_q, block_k), seq_len, dropout_rate)
+            keep = _dropout_keep(mix, q_off + qi * block_q,
+                                 k_off + j * block_k,
+                                 (block_q, block_k), hash_seq_len,
+                                 dropout_rate)
             p_v = _apply_dropout(p, keep, dropout_rate)
         else:
             p_v = p
@@ -287,8 +314,10 @@ def _pad_qkv(q, k, v, block_q, block_k, causal):
 
 
 def _dropout_seed_arg(seed, dropout_rate: float = 0.0) -> jax.Array:
-    """Normalize the optional dropout seed to the (1,) uint32 SMEM operand
-    every kernel takes (ignored when dropout_rate == 0)."""
+    """Normalize the optional dropout seed to the (SEED_WORDS,) uint32
+    SMEM operand every kernel takes (ignored when dropout_rate == 0).
+    Accepts a scalar/(1,) seed (offsets zero — the non-ring path) or a
+    full (SEED_WORDS,) vector (ring callers supply global offsets)."""
     if seed is None:
         if dropout_rate > 0.0:
             # A silent constant seed would drop the SAME attention entries
@@ -296,8 +325,12 @@ def _dropout_seed_arg(seed, dropout_rate: float = 0.0) -> jax.Array:
             raise ValueError(
                 "flash attention dropout needs a per-step seed ((1,) "
                 "uint32) when dropout_rate > 0")
-        return jnp.zeros((1,), jnp.uint32)
-    return jnp.asarray(seed, jnp.uint32).reshape((1,))
+        return jnp.zeros((SEED_WORDS,), jnp.uint32)
+    seed = jnp.asarray(seed, jnp.uint32).reshape(-1)
+    if seed.shape[0] == SEED_WORDS:
+        return seed
+    return jnp.concatenate(
+        [seed[:1], jnp.zeros((SEED_WORDS - 1,), jnp.uint32)])
 
 
 def _check_dropout_seq_len(dropout_rate: float, padded_len: int) -> None:
@@ -316,18 +349,27 @@ def _pallas_flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       block_q: int = DEFAULT_BLOCK,
                       block_k: int = DEFAULT_BLOCK,
                       interpret: bool = False,
-                      dropout_rate: float = 0.0, seed=None):
+                      dropout_rate: float = 0.0, seed=None,
+                      hash_heads: int | None = None,
+                      hash_seq_len: int | None = None):
     """Returns (out, lse) — lse is the lane-replicated per-row logsumexp
-    with PADDED shape (B*H, Tp, 128); the bwd kernels consume it as-is."""
+    with PADDED shape (B*H, Tp, 128); the bwd kernels consume it as-is.
+
+    hash_heads / hash_seq_len: GLOBAL head count and sequence length the
+    dropout mask hash is keyed over (ring callers pass the global values
+    with per-shard offsets in the seed vector); default local/padded."""
     block_q, block_k = _clamp_blocks(q.shape[2], block_q, block_k)
     qf, kf, vf, (B, H, T, D, Tp, Dp, pad_T, pad_D) = _pad_qkv(
         q, k, v, block_q, block_k, causal)
 
-    _check_dropout_seq_len(dropout_rate, Tp)
+    hash_heads = hash_heads if hash_heads is not None else H
+    hash_seq_len = hash_seq_len if hash_seq_len is not None else Tp
+    _check_dropout_seq_len(dropout_rate, hash_seq_len)
     grid = (B * H, Tp // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
-        sm_scale=sm_scale, causal=causal, dropout_rate=dropout_rate)
+        sm_scale=sm_scale, causal=causal, dropout_rate=dropout_rate,
+        local_heads=H, hash_heads=hash_heads, hash_seq_len=hash_seq_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -416,10 +458,15 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
                          lse_ref, dq_ref, *, block_q: int, block_k: int,
                          sm_scale: float, causal: bool, has_dlse: bool,
                          dropout_rate: float = 0.0,
-                         stat_layout: str = "replicated"):
+                         stat_layout: str = "replicated",
+                         local_heads: int = 1, hash_heads: int = 1,
+                         hash_seq_len: int = 0):
     qi = pl.program_id(1)
     if dropout_rate > 0.0:
-        mix = _dropout_tile_seed(seed_ref, pl.program_id(0))
+        mix = _dropout_tile_seed(seed_ref, pl.program_id(0),
+                                 local_heads, hash_heads)
+        q_off = seed_ref[3].astype(jnp.int32)
+        k_off = seed_ref[4].astype(jnp.int32)
     q = q_ref[0]                                     # (bq, D) storage dtype
     do = do_ref[0]
     # The row term Drow = rowsum(dO * O) is computed HERE from the o
@@ -471,8 +518,10 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
             # the mask (and its 1/(1-r) rescale) lands on dp; the row term
             # drow = rowsum(do*o) already equals rowsum(dp_masked * p) and
             # needs no correction.
-            keep = _dropout_keep(mix, qi * block_q, j * block_k,
-                                 (block_q, block_k), seq_len, dropout_rate)
+            keep = _dropout_keep(mix, q_off + qi * block_q,
+                                 k_off + j * block_k,
+                                 (block_q, block_k), hash_seq_len,
+                                 dropout_rate)
             dp = _apply_dropout(dp, keep, dropout_rate)
         ds = p * (dp - drow)
         return dq_acc + lax.dot_general(
@@ -490,10 +539,15 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
                           lse_ref, dk_ref, dv_ref, *, block_q: int,
                           block_k: int, sm_scale: float, causal: bool,
                           has_dlse: bool, dropout_rate: float = 0.0,
-                          stat_layout: str = "replicated"):
+                          stat_layout: str = "replicated",
+                          local_heads: int = 1, hash_heads: int = 1,
+                          hash_seq_len: int = 0):
     ki = pl.program_id(1)
     if dropout_rate > 0.0:
-        mix = _dropout_tile_seed(seed_ref, pl.program_id(0))
+        mix = _dropout_tile_seed(seed_ref, pl.program_id(0),
+                                 local_heads, hash_heads)
+        q_off = seed_ref[3].astype(jnp.int32)
+        k_off = seed_ref[4].astype(jnp.int32)
     k = k_ref[0]                                      # (bk, D)
     v = v_ref[0]
     seq_len = q_ref.shape[1]
@@ -539,8 +593,10 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         if dropout_rate > 0.0:
             # Same positional mask as fwd/dq; dv sums the MASKED p~ = the
             # probabilities that actually multiplied v in the forward.
-            keep = _dropout_keep(mix, i * block_q, ki * block_k,
-                                 (block_q, block_k), seq_len, dropout_rate)
+            keep = _dropout_keep(mix, q_off + i * block_q,
+                                 k_off + ki * block_k,
+                                 (block_q, block_k), hash_seq_len,
+                                 dropout_rate)
             p_v = _apply_dropout(p, keep, dropout_rate)
         else:
             p_v = p
@@ -578,7 +634,9 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
                       block_k: int = DEFAULT_BLOCK,
                       interpret: bool = False, dlse=None,
                       dropout_rate: float = 0.0, seed=None,
-                      stat_layout: str = "replicated"):
+                      stat_layout: str = "replicated",
+                      hash_heads: int | None = None,
+                      hash_seq_len: int | None = None):
     """lse arrives compact and T-padded from the forward: (B*H, Tp, 1) f32.
 
     stat_layout picks the HBM operand the kernels read it through:
@@ -638,14 +696,17 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         dq_stats_spec = pl.BlockSpec((1, block_q, W), lambda b, i: (b, i, 0))
         dkv_stats_spec = pl.BlockSpec((1, Tp, W), lambda b, j: (b, 0, 0))
 
-    _check_dropout_seq_len(dropout_rate, Tp)
+    hash_heads = hash_heads if hash_heads is not None else H
+    hash_seq_len = hash_seq_len if hash_seq_len is not None else Tp
+    _check_dropout_seq_len(dropout_rate, hash_seq_len)
     seed_arg = _dropout_seed_arg(seed, dropout_rate)
     grid_q = (B * H, Tp // block_q)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, sm_scale=sm_scale, causal=causal,
                           has_dlse=has_dlse, dropout_rate=dropout_rate,
-                          stat_layout=stat_layout),
+                          stat_layout=stat_layout, local_heads=H,
+                          hash_heads=hash_heads, hash_seq_len=hash_seq_len),
         grid=grid_q,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -675,7 +736,8 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
                           block_k=dkv_block_k, sm_scale=sm_scale,
                           causal=causal, has_dlse=has_dlse,
                           dropout_rate=dropout_rate,
-                          stat_layout=stat_layout),
+                          stat_layout=stat_layout, local_heads=H,
+                          hash_heads=hash_heads, hash_seq_len=hash_seq_len),
         grid=grid_k,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -863,6 +925,110 @@ def _flash_lse_bwd_rule(causal, sm_scale, interpret, stat_layout, res, cts):
 
 
 flash_attention_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def flash_attention_lse_dropout(q, k, v, seed, causal: bool = True,
+                                sm_scale: float | None = None,
+                                dropout_rate: float = 0.0,
+                                interpret: bool = False,
+                                stat_layout: str = "replicated",
+                                hash_heads: int | None = None,
+                                hash_seq_len: int | None = None):
+    """flash_attention_lse + in-kernel dropout keyed on GLOBAL coordinates
+    — the block primitive regularized ring attention composes.
+
+    seed: (SEED_WORDS,) uint32 [seed, b_off, h_off, q_off, k_off] (or a
+    (1,) seed for the degenerate unsharded case). hash_heads /
+    hash_seq_len are the GLOBAL head count and sequence length the mask
+    hash is keyed over, so every ring step (and the dq/dkv backward
+    kernels recomputing P) reconstructs the same mask for the same global
+    score element regardless of which shard computes it.
+
+    The returned lse is the logsumexp of the UNMASKED scores (dropout
+    applies to normalized probabilities; the normalizer is mask-free), so
+    ring merging of (out_j, lse_j) pairs over dropout blocks is exact:
+    the masked probabilities are rescaled by the same global normalizer
+    the unmasked merge computes.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    out, lse = _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 interpret=interpret,
+                                 dropout_rate=dropout_rate, seed=seed,
+                                 hash_heads=hash_heads,
+                                 hash_seq_len=hash_seq_len)
+    return out, _compact_lse(lse, q.shape)
+
+
+def _flash_lse_dropout_fwd_rule(q, k, v, seed, causal, sm_scale,
+                                dropout_rate, interpret,
+                                stat_layout="replicated",
+                                hash_heads=None, hash_seq_len=None):
+    from jax.ad_checkpoint import checkpoint_name
+
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    o, lse = _pallas_flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                               interpret=interpret,
+                               dropout_rate=dropout_rate, seed=seed,
+                               hash_heads=hash_heads,
+                               hash_seq_len=hash_seq_len)
+    o = checkpoint_name(o, "attn_out")  # see _flash_fwd_rule
+    return ((o, _compact_lse(lse, q.shape)),
+            (q, k, v, o, checkpoint_name(lse[..., :1], "attn_lse"), seed))
+
+
+def _flash_lse_dropout_bwd_rule(causal, sm_scale, dropout_rate, interpret,
+                                stat_layout, hash_heads, hash_seq_len,
+                                res, cts):
+    q, k, v, o, lse, seed = res
+    do, dlse = cts
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    dq, dk, dv = _pallas_flash_bwd(q, k, v, o, lse, do, causal=causal,
+                                   sm_scale=sm_scale, interpret=interpret,
+                                   dlse=dlse, dropout_rate=dropout_rate,
+                                   seed=seed, stat_layout=stat_layout,
+                                   hash_heads=hash_heads,
+                                   hash_seq_len=hash_seq_len)
+    return dq, dk, dv, None
+
+
+flash_attention_lse_dropout.defvjp(_flash_lse_dropout_fwd_rule,
+                                   _flash_lse_dropout_bwd_rule)
+
+
+def hash_dropout_keep_mask(seed, B: int, H: int, Tq: int, Tk: int, *,
+                           q_off=0, k_off=0, b_off=0, h_off=0,
+                           hash_heads: int | None = None,
+                           hash_seq_len: int | None = None,
+                           rate: float = 0.1) -> jax.Array:
+    """The EXACT (B, H, Tq, Tk) keep-mask the Pallas kernels derive, as
+    plain jnp ops — shared by the XLA ring block (so pallas and xla ring
+    impls drop identical elements for the same seed) and by tests
+    verifying the in-kernel mask against a dense reference."""
+    seed = _dropout_seed_arg(seed, rate)
+    hash_heads = hash_heads if hash_heads is not None else H
+    if hash_seq_len is None:
+        # Match the kernels' default: they hash over the BLOCK-PADDED
+        # length, which (clamped blocks always divide the 128-padded T)
+        # is T rounded up to a multiple of 128 — not the raw Tq.
+        hash_seq_len = -(-Tq // LANES) * LANES
+    bh = jnp.arange(B * H, dtype=jnp.uint32)
+    b = bh // jnp.uint32(H) + seed[1] + jnp.uint32(b_off)
+    h = bh % jnp.uint32(H) + seed[2] + jnp.uint32(h_off)
+    mix = _fmix32(seed[0] ^ ((b * jnp.uint32(hash_heads) + h)
+                             * jnp.uint32(_GOLDEN)))        # (B*H,)
+    q_pos = (seed[3].astype(jnp.int32) + q_off
+             + jnp.arange(Tq))[:, None]
+    k_pos = (seed[4].astype(jnp.int32) + k_off
+             + jnp.arange(Tk))[None, :]
+    idx = (q_pos.astype(jnp.uint32) * jnp.uint32(hash_seq_len)
+           + k_pos.astype(jnp.uint32))                       # (Tq, Tk)
+    threshold = jnp.uint32(min(int(round(rate * 2**32)), 2**32 - 1))
+    keep = _fmix32(idx[None] ^ mix[:, None, None]) >= threshold
+    return keep.reshape(B, H, Tq, Tk)
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,17 @@ class MetricsWriter:
         except Exception:
             return None
 
+    def write_header(self, meta: dict[str, Any]) -> None:
+        """One provenance record at the top of metrics.jsonl — run policy
+        facts a reader needs to interpret the stream but that are not
+        per-step scalars (fixed-eval-batch seed policy, which offset
+        sampler the loader resolved, rng impl). Round-4 VERDICT weak #5/#7:
+        both were undocumented in run artifacts."""
+        if not self.enabled or self.jsonl is None:
+            return
+        self.jsonl.write(json.dumps({"header": meta,
+                                     "time": time.time()}) + "\n")
+
     def log(self, step: int, scalars: dict[str, Any]) -> None:
         if not self.enabled:
             return
