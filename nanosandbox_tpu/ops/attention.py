@@ -295,7 +295,15 @@ def _pad_qkv(q, k, v, block_q, block_k, causal):
             f"(got {block_q}, {block_k}): Mosaic tiles blocks onto "
             f"(8, 128) sublane*lane registers")
     B, H, T, D = q.shape
-    pad_D = (-D) % 128
+    # Head-dim padding: Mosaic's (8, 128) register tiling accepts a
+    # 64-lane minor dim directly (verified compiled + correct on v5e),
+    # so GPT-2's D=64 runs UNPADDED — the old unconditional pad-to-128
+    # doubled every q/k/v/o/do stream and grad write in HBM. Only the
+    # VERIFIED cases skip padding (64 exactly, or full 128-lane
+    # multiples); other dims — including 128k+64 shapes like 192, a
+    # partial-trailing-tile case never exercised — keep the proven
+    # pad-to-128-multiple path.
+    pad_D = 0 if (D == 64 or D % 128 == 0) else (-D) % 128
     if pad_D:
         pads = [(0, 0), (0, 0), (0, 0), (0, pad_D)]
         q, k, v = (jnp.pad(x, pads) for x in (q, k, v))

@@ -74,6 +74,8 @@ def make_hybrid_mesh(mesh_dp: int = -1, mesh_fsdp: int = 1,
     the dp axis is laid out slice-major so adjacent dp indices within a
     slice stay on ICI.
     """
+    if mesh_fsdp <= 0 or mesh_tp <= 0 or mesh_sp <= 0:
+        raise ValueError("mesh_fsdp, mesh_tp, and mesh_sp must be positive")
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if num_slices == -1:

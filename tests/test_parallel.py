@@ -240,27 +240,51 @@ def test_hybrid_mesh_rejects_ici_axes_crossing_slices():
         make_hybrid_mesh(num_slices=3)
 
 
-def test_hybrid_mesh_trainer_end_to_end(tiny_cfg):
+def test_hybrid_mesh_trainer_end_to_end(tiny_cfg, tmp_path):
     """A Trainer on a 2-slice hybrid mesh (dp across slices, fsdp inside)
     runs a real step, and the loss matches the flat-mesh run on the same
-    batch — the hybrid layout is a placement change, not a math change."""
-    cfg = tiny_cfg.replace(batch_size=8, mesh_fsdp=2, mesh_slices=2,
-                           shard_params=True)
-    trainer = Trainer(cfg)
-    assert dict(trainer.mesh.shape) == {"data": 4, "fsdp": 2, "seq": 1,
-                                        "model": 1}
-    state = trainer.init_state()
-    step, _ = trainer.compiled_steps()
-    xg, yg = trainer.dataset.sample_batch(
-        "train", 0, cfg.batch_size, cfg.block_size, seed=cfg.seed)
-    _, m = step(state, trainer.to_global(xg), trainer.to_global(yg),
-                jax.random.key(0))
-    loss = float(m["loss"])
+    batch — the hybrid layout is a placement change, not a math change.
 
-    flat = Trainer(tiny_cfg.replace(batch_size=8, mesh_fsdp=2,
-                                    shard_params=True))
-    fstate = flat.init_state()
-    fstep, _ = flat.compiled_steps()
-    _, fm = fstep(fstate, flat.to_global(xg), flat.to_global(yg),
-                  jax.random.key(0))
-    assert loss == pytest.approx(float(fm["loss"]), rel=1e-5)
+    Runs in a FRESH subprocess: two back-to-back collective-heavy
+    Trainer steps in-process would raise the odds of XLA:CPU's 40s
+    collective-rendezvous watchdog aborting a long pytest session (the
+    recorded flake mode; see test_train_smoke.test_rng_impl_rbg_trains
+    for the same pattern)."""
+    import subprocess
+    import sys
+
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from nanosandbox_tpu.train import Trainer
+from nanosandbox_tpu.config import TrainConfig
+cfg = TrainConfig(**{tiny_cfg.replace(batch_size=8, mesh_fsdp=2,
+                                      mesh_slices=2,
+                                      shard_params=True).to_dict()!r})
+trainer = Trainer(cfg)
+assert dict(trainer.mesh.shape) == dict(data=4, fsdp=2, seq=1, model=1), \\
+    trainer.mesh.shape
+state = trainer.init_state()
+step, _ = trainer.compiled_steps()
+xg, yg = trainer.dataset.sample_batch(
+    "train", 0, cfg.batch_size, cfg.block_size, seed=cfg.seed)
+_, m = step(state, trainer.to_global(xg), trainer.to_global(yg),
+            jax.random.key(0))
+loss = float(m["loss"])
+
+flat = Trainer(cfg.replace(mesh_slices=0))
+fstate = flat.init_state()
+fstep, _ = flat.compiled_steps()
+_, fm = fstep(fstate, flat.to_global(xg), flat.to_global(yg),
+              jax.random.key(0))
+flat_loss = float(fm["loss"])
+assert abs(loss - flat_loss) <= 1e-5 * abs(flat_loss), (loss, flat_loss)
+print(f"HYBRID_OK {{loss:.8f}} {{flat_loss:.8f}}")
+"""
+    env = dict(__import__("os").environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "HYBRID_OK" in proc.stdout
