@@ -543,13 +543,36 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
-                          lse_ref, dk_ref, dv_ref, *, block_q: int,
-                          block_k: int, sm_scale: float, causal: bool,
-                          has_dlse: bool, dropout_rate: float = 0.0,
-                          stat_layout: str = "replicated",
-                          local_heads: int = 1, hash_heads: int = 1,
-                          hash_seq_len: int = 0):
+def _flash_bwd_tiles_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
+                            lse_ref, *out_refs, block_q: int, block_k: int,
+                            sm_scale: float, causal: bool, has_dlse: bool,
+                            with_dq: bool, dropout_rate: float = 0.0,
+                            stat_layout: str = "replicated",
+                            local_heads: int = 1, hash_heads: int = 1,
+                            hash_seq_len: int = 0):
+    """The key-parallel backward walk, shared by BOTH backward strategies.
+
+    Grid (batch*head, key blocks); inner loop over the causal q-block
+    range, computing per tile: p = exp(s - L), dv += p~^T dO,
+    dp = dO V^T, ds = p (dp - Drow), dk += ds^T Q.
+
+    with_dq=False: out_refs = (dk_ref, dv_ref) — the split strategy's
+    dKV kernel (a separate q-parallel kernel computes dQ).
+    with_dq=True: out_refs = (dq_ref, dk_ref, dv_ref) — the FUSED
+    one-pass strategy: the same ds additionally accumulates dq += ds K
+    into an f32 output block that stays RESIDENT in VMEM across the
+    (sequential, 'arbitrary'-semantics) key grid dimension and flushes
+    once per batch*head. The split backward recomputes s/exp/dp twice
+    (once per kernel); fused computes each causal tile once and feeds
+    all three gradients — r5 measured 124M bench 147 -> 141.6 ms. Cost:
+    a (Tp, D) f32 VMEM accumulator (256 KB at the 124M shape); dq is
+    scaled by sm_scale and cast OUTSIDE the kernel (XLA fuses both into
+    the unpad copy).
+    """
+    if with_dq:
+        dq_ref, dk_ref, dv_ref = out_refs
+    else:
+        dk_ref, dv_ref = out_refs
     ki = pl.program_id(1)
     if dropout_rate > 0.0:
         mix = _dropout_tile_seed(seed_ref, pl.program_id(0),
@@ -571,6 +594,12 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         diag_end = 0
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
+
+    if with_dq:
+        # The dq accumulator is revisited across ki: zero on first visit.
+        @pl.when(ki == 0)
+        def _zero_dq():
+            dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
     def body(i, carry, *, masked: bool):
         dk_acc, dv_acc = carry
@@ -620,6 +649,12 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         dk_acc = dk_acc + lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (bk, D)
+        if with_dq:
+            dq_blk = dq_ref[0, pl.ds(i * block_q, block_q), :]
+            dq_ref[0, pl.ds(i * block_q, block_q), :] = (
+                dq_blk + lax.dot_general(
+                    ds, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))  # f32 accum
         return dk_acc, dv_acc
 
     D = k.shape[1]
@@ -635,6 +670,15 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
                                functools.partial(body, masked=False), init)
     dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# Backward strategy: 'fused' (one pass, dq resident — the r5 default) or
+# 'split' (q-parallel dQ kernel + key-parallel dKV walk). Both strategies
+# share _flash_bwd_tiles_kernel for the dk/dv math, so they cannot drift
+# there; tests/test_attention.py pins fused-vs-split gradient parity so
+# the split path stays exercised. NOT an automatic fallback: the compile
+# probe degrades auto -> XLA attention, never fused -> split.
+BWD_IMPL = "fused"
 
 
 def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
@@ -708,6 +752,53 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
     hash_seq_len = hash_seq_len if hash_seq_len is not None else Tp
     _check_dropout_seq_len(dropout_rate, hash_seq_len)
     seed_arg = _dropout_seed_arg(seed, dropout_rate)
+
+    unpad = lambda g: g.reshape(B, H, Tp, Dp)[:, :, :T, :D]
+    if BWD_IMPL == "fused":
+        # One pass over the causal tiles computing all three grads; dq is
+        # an f32 accumulator block resident across the (sequential) key
+        # grid dimension, scaled+cast outside (XLA fuses both into the
+        # unpad copy). dkv_stats_spec already serves the per-q-block
+        # stats reads this kernel does.
+        grid_f = (B * H, Tp // block_k)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_tiles_kernel, block_q=block_q,
+                              block_k=block_k, sm_scale=sm_scale,
+                              causal=causal, has_dlse=has_dlse,
+                              with_dq=True,
+                              dropout_rate=dropout_rate,
+                              stat_layout=stat_layout, local_heads=H,
+                              hash_heads=hash_heads,
+                              hash_seq_len=hash_seq_len),
+            grid=grid_f,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
+                dkv_stats_spec,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, Tp, Dp), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, Tp, Dp), jnp.float32),
+                jax.ShapeDtypeStruct((B * H, Tp, Dp), k.dtype),
+                jax.ShapeDtypeStruct((B * H, Tp, Dp), v.dtype),
+            ],
+            # The key grid dim is 'arbitrary' (sequential): the resident
+            # dq block's read-modify-write across ki requires it.
+            compiler_params=None if interpret else _tpu_params(
+                "parallel", "arbitrary"),
+            interpret=interpret,
+        )(seed_arg, qf, kf, vf, of, dof, statsf)
+        return (unpad(dq * sm_scale).astype(q.dtype),
+                unpad(dk).astype(k.dtype), unpad(dv).astype(v.dtype))
+
     grid_q = (B * H, Tp // block_q)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
@@ -740,9 +831,10 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
 
     grid_k = (B * H, Tp // dkv_block_k)
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+        functools.partial(_flash_bwd_tiles_kernel, block_q=block_q,
                           block_k=dkv_block_k, sm_scale=sm_scale,
                           causal=causal, has_dlse=has_dlse,
+                          with_dq=False,
                           dropout_rate=dropout_rate,
                           stat_layout=stat_layout, local_heads=H,
                           hash_heads=hash_heads, hash_seq_len=hash_seq_len),
@@ -769,7 +861,6 @@ def _pallas_flash_bwd(q, k, v, o, lse, do, *, causal: bool, sm_scale: float,
         interpret=interpret,
     )(seed_arg, qf, kf, vf, of, dof, statsf)
 
-    unpad = lambda g: g.reshape(B, H, Tp, Dp)[:, :, :T, :D]
     return (unpad(dq).astype(q.dtype), unpad(dk).astype(k.dtype),
             unpad(dv).astype(v.dtype))
 

@@ -210,8 +210,8 @@ def test_save_attention_policy_elides_kernel_recompute():
     """The policy's reason to exist, pinned by counting pallas_calls in
     the grad jaxpr: a remat region discards custom_vjp residuals, so
     without the checkpoint_name tags on (o, lse) the flash forward runs
-    TWICE in the backward (4 calls); with them it runs once (3 = fwd +
-    bwd_dq + bwd_dkv), same as no remat."""
+    TWICE in the backward (3 calls/layer); with them it runs once
+    (2 = fwd + fused one-pass bwd), same as no remat."""
 
     def count_calls(remat, policy):
         cfg = tiny(block_size=128, attention_impl="pallas_interpret",
@@ -227,6 +227,6 @@ def test_save_attention_policy_elides_kernel_recompute():
         return str(jax.make_jaxpr(jax.grad(loss))(params)).count(
             "pallas_call")
 
-    assert count_calls(False, "full") == 3 * tiny().n_layer
-    assert count_calls(True, "full") == 4 * tiny().n_layer
-    assert count_calls(True, "save_attention") == 3 * tiny().n_layer
+    assert count_calls(False, "full") == 2 * tiny().n_layer
+    assert count_calls(True, "full") == 3 * tiny().n_layer
+    assert count_calls(True, "save_attention") == 2 * tiny().n_layer

@@ -240,7 +240,7 @@ def test_hybrid_mesh_rejects_ici_axes_crossing_slices():
         make_hybrid_mesh(num_slices=3)
 
 
-def test_hybrid_mesh_trainer_end_to_end(tiny_cfg, tmp_path):
+def test_hybrid_mesh_trainer_end_to_end(tiny_cfg):
     """A Trainer on a 2-slice hybrid mesh (dp across slices, fsdp inside)
     runs a real step, and the loss matches the flat-mesh run on the same
     batch — the hybrid layout is a placement change, not a math change.
@@ -282,9 +282,14 @@ flat_loss = float(fm["loss"])
 assert abs(loss - flat_loss) <= 1e-5 * abs(flat_loss), (loss, flat_loss)
 print(f"HYBRID_OK {{loss:.8f}} {{flat_loss:.8f}}")
 """
-    env = dict(__import__("os").environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    import os
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run([sys.executable, "-c", code], env=env,
-                          capture_output=True, text=True, timeout=600)
+                          cwd=repo_root, capture_output=True, text=True,
+                          timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "HYBRID_OK" in proc.stdout
