@@ -41,8 +41,18 @@ from jax.experimental.pallas import tpu as pltpu
 def _tpu_params(*semantics: str):
     """Mosaic grid-dimension semantics: 'parallel' dims may be executed in
     any order / across cores, letting the pipeline prefetch blocks across
-    grid steps instead of serializing them."""
-    return pltpu.CompilerParams(dimension_semantics=semantics)
+    grid steps instead of serializing them.
+
+    vmem_limit_bytes raises Mosaic's default (~16 MB) VMEM budget check to
+    100 MB of the chip's 128: the backward kernels stream q/do/o as
+    full-T blocks, whose footprint scales with sequence length — at the
+    default budget the backward stops COMPILING between T=8192 and 16384
+    (and the 'replicated' stat layout already fails at 8192 with 12
+    heads). The limit is a constraint check, not an allocation: small
+    kernels are unaffected (124M bench measured identical), and with it
+    the single-shard envelope extends through T=32768 (r5, v5e)."""
+    return pltpu.CompilerParams(dimension_semantics=semantics,
+                                vmem_limit_bytes=100 * 1024 * 1024)
 
 NEG_INF = -1e30
 LANES = 128  # minor-dim register width; row stats are replicated across it

@@ -416,3 +416,19 @@ def test_ring_dropout_single_device_degenerate_on_hardware():
         q, k, v, seed, True, None, 0.2, False))(q, k, v)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32), atol=2e-2)
+
+
+@pytest.mark.parametrize("T", [16384, 32768])
+def test_flash_bwd_compiles_at_long_context(T):
+    """The single-shard long-context envelope (round-5): the backward
+    kernels stream full-T q/do/o blocks, so VMEM footprint scales with T
+    — at Mosaic's default budget the backward stopped COMPILING between
+    8k and 16k. The raised vmem_limit_bytes in _tpu_params extends the
+    envelope through 32k; this pins it (AOT compile only, cheap)."""
+    x = jax.ShapeDtypeStruct((1, 12, T, 64), jnp.bfloat16)
+
+    def loss(q):
+        return flash_attention(q, q, q, True, None, False,
+                               "compact").astype(jnp.float32).sum()
+
+    jax.jit(jax.grad(loss)).lower(x).compile()
