@@ -1,4 +1,6 @@
-"""Benchmark: GPT-2 124M training throughput, tokens/sec/chip.
+"""Benchmark: GPT-2 124M training throughput, tokens/sec/chip — and,
+with --mode=decode, continuous-batching inference throughput through
+the serve engine (nanosandbox_tpu/serve/).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -11,6 +13,8 @@ tokens/sec/chip divided by that estimate (>1.0 beats the reference's
 per-device hardware).
 
 Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
+       python bench.py --mode=decode [--quick] [--slots=N] \
+           [--max_new_tokens=N] [--requests=N]
 """
 
 from __future__ import annotations
@@ -92,6 +96,89 @@ def build_config(kv: dict, *, on_tpu: bool, n_chips: int, tmp: str,
     return cfg, warmup, iters
 
 
+def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
+    """Batched-decode tokens/sec through the serve engine.
+
+    Measures the serving metric that matters — aggregate generated
+    tokens/sec across a full continuous batch with mixed prompt lengths
+    and mid-flight backfill — not batch-1 latency. Params are randomly
+    initialized (throughput does not depend on the weights) and cast to
+    the serving dtype, exactly as `python -m nanosandbox_tpu.serve`
+    casts a restored checkpoint. A warmup drain first touches every
+    prefill bucket so compilation never lands inside the timed window.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.sample import cast_params_for_serving
+    from nanosandbox_tpu.serve import Engine
+
+    if on_tpu:  # GPT-2 124M, the train bench's model, in serving dtype
+        cfg = GPTConfig(n_layer=12, n_head=12, n_embd=768, block_size=1024,
+                        vocab_size=50304, dropout=0.0,
+                        compute_dtype="bfloat16", attention_impl="auto")
+        max_len, max_new = 512, (64 if quick else 128)
+    else:  # CPU fallback keeps the bench runnable anywhere
+        cfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=128,
+                        vocab_size=256, dropout=0.0,
+                        compute_dtype="float32", attention_impl="xla")
+        max_len, max_new = 64, (8 if quick else 16)
+
+    num_slots = int(kv.get("slots", 8))
+    max_new = int(kv.get("max_new_tokens", max_new))
+    n_requests = int(kv.get("requests", 2 * num_slots))
+
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    params = cast_params_for_serving(params, cfg.compute_dtype)
+    engine = Engine(model, params, num_slots=num_slots, max_len=max_len)
+
+    rng = __import__("numpy").random.default_rng(0)
+    def submit_mix(n):
+        for i in range(n):
+            # One warmup prompt per bucket rung, then mixed lengths.
+            L = engine.sched.buckets[i % len(engine.sched.buckets)] \
+                if i < len(engine.sched.buckets) else \
+                int(rng.integers(1, max(2, max_len - max_new)))
+            L = min(L, max_len - max_new)
+            prompt = rng.integers(0, cfg.vocab_size, max(L, 1)).tolist()
+            engine.submit(prompt, max_new)
+
+    submit_mix(len(engine.sched.buckets) + 1)  # warmup: compile everything
+    engine.drain()
+
+    submit_mix(n_requests)
+    t0 = time.perf_counter()
+    results = engine.drain()
+    dt = time.perf_counter() - t0
+    generated = sum(len(r.tokens) for r in results)
+
+    return {
+        "metric": "gpt2_124m_batched_decode_tokens_per_sec" if on_tpu
+        else "tiny_batched_decode_tokens_per_sec_cpu",
+        "value": generated / dt,
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no published serving baseline (BASELINE.json)
+        "extra": {
+            "backend": jax.default_backend(),
+            "num_slots": num_slots,
+            "max_len": max_len,
+            "max_new_tokens": max_new,
+            "requests": n_requests,
+            "tokens_generated": generated,
+            "decode_steps": engine.steps,
+            "prefill_buckets": list(engine.sched.buckets),
+            "trace_counts": dict(engine.trace_counts),
+            "elapsed_s": dt,
+        },
+    }
+
+
 def main(argv: list[str]) -> dict:
     quick = "--quick" in argv
     kv = dict(a.lstrip("-").split("=", 1) for a in argv if "=" in a)
@@ -99,6 +186,11 @@ def main(argv: list[str]) -> dict:
 
     on_tpu = jax.default_backend() == "tpu"
     n_chips = len(jax.devices())
+
+    if kv.get("mode", "train") == "decode":
+        result = bench_decode(kv, quick=quick, on_tpu=on_tpu)
+        print(json.dumps(result))
+        return result
     impl_status = preflight_impls()
 
     tmp = tempfile.mkdtemp(prefix="bench_")

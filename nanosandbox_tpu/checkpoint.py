@@ -36,7 +36,16 @@ class Checkpointer:
     def save(self, step: int, state: Any, extra: dict | None = None,
              wait: bool = False) -> None:
         if step in (self.mgr.all_steps() or []):
-            return  # resume re-evals at the restored step; don't re-save
+            # Resume re-evals at the restored step; don't re-save. Say so
+            # once — a resumed run that never logs a save otherwise looks
+            # like checkpointing silently stopped (the repeats stay quiet:
+            # every eval_interval hit would re-trigger this).
+            from nanosandbox_tpu.utils.metrics import warn_once
+            warn_once(f"ckpt-skip:{self.out_dir}",
+                      f"[checkpoint] step {step} already exists under "
+                      f"{self.out_dir}/ckpt; skipping save (expected once "
+                      "right after --init_from=resume)")
+            return
         args = {"state": ocp.args.StandardSave(state)}
         if extra is not None:
             args["extra"] = ocp.args.JsonSave(extra)

@@ -78,17 +78,33 @@ class CausalSelfAttention(nn.Module):
             from jax import lax
 
             ck, cv = cache
-            ck = lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
-            cv = lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
             Tc = ck.shape[2]
-            qpos = cache_index + jnp.arange(T)          # (T,) global positions
-            mask = jnp.arange(Tc)[None, :] <= qpos[:, None]  # (T, Tc)
+            if getattr(cache_index, "ndim", 0) == 1:
+                # Per-row frontiers (serve engine's slot pool): each batch
+                # row b writes its K/V at its OWN position cache_index[b]
+                # and attends up to it. vmap over the batch dim turns the
+                # single dynamic_update_slice into one write per row —
+                # the shapes stay fixed, so one compiled decode step
+                # serves every mix of in-flight request lengths.
+                def _row_write(buf, x, i):
+                    return lax.dynamic_update_slice(buf, x, (0, i, 0))
+                ck = jax.vmap(_row_write)(ck, k.astype(ck.dtype), cache_index)
+                cv = jax.vmap(_row_write)(cv, v.astype(cv.dtype), cache_index)
+                qpos = cache_index[:, None] + jnp.arange(T)[None, :]  # (B, T)
+            else:
+                ck = lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
+                cv = lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
+                qpos = (cache_index + jnp.arange(T))[None, :]  # (1, T) global
+            # (B|1, 1, T, Tc): kpos <= qpos. The unwritten/stale buffer
+            # tail beyond each row's frontier is masked off, so garbage
+            # K/V from a previous slot occupant never contributes.
+            mask = jnp.arange(Tc)[None, None, None, :] <= qpos[:, None, :, None]
             scores = jnp.einsum("bhtd,bhsd->bhts", q, ck,
                                 preferred_element_type=jnp.float32)
             scores = scores * (1.0 / head_dim ** 0.5)
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             y = jnp.einsum("bhts,bhsd->bhtd", probs.astype(cv.dtype), cv)
             new_cache = (ck, cv)
@@ -248,8 +264,11 @@ class GPT(nn.Module):
         ever materializing full logits in HBM.
 
         Incremental decode: pass ``cache`` (per-layer (K, V) buffers from
-        init_cache) and ``cache_index`` (global position of idx[:, 0]);
-        returns (logits, new_cache). Each call attends against everything
+        init_cache) and ``cache_index`` (global position of idx[:, 0] —
+        a scalar, or a (B,) int32 vector giving each row its OWN position,
+        the serve engine's slot-pool contract where every row is an
+        independent request at its own frontier); returns
+        (logits, new_cache). Each call attends against everything
         written so far, so a prefill call (T = prompt length) followed by
         T=1 calls decodes in O(T) total attention reads instead of the
         windowed full-forward's O(T * block_size) recompute per token."""
@@ -266,7 +285,12 @@ class GPT(nn.Module):
                        param_dtype=cfg.param_dtype, name="wpe")
 
         if cache is not None:
-            pos = cache_index + jnp.arange(T)[None, :]
+            if getattr(cache_index, "ndim", 0) == 1:
+                # Per-row decode positions (serve slot pool): row b's
+                # tokens sit at cache_index[b] + [0, T).
+                pos = cache_index[:, None] + jnp.arange(T)[None, :]
+            else:
+                pos = cache_index + jnp.arange(T)[None, :]
         else:
             pos = jnp.arange(T)[None, :]
         x = self._constrain_acts(wte(idx) + wpe(pos))

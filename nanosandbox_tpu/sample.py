@@ -24,11 +24,22 @@ def _sample_token(logits_i, rng, *, temperature: float, top_k: int,
     (argmax, no RNG consumed) — torch's convention and the determinism
     anchor for the cached-vs-windowed parity tests. top_k and top_p
     (nucleus) compose: k-truncation first, then the smallest probability
-    mass >= top_p survives."""
+    mass >= top_p survives.
+
+    temperature/top_k/top_p may also be (B,) vectors — each row then
+    samples under its OWN parameters (the serve engine's continuous
+    batch mixes requests with different settings in one step). The
+    vector path also accepts ``rng`` as a (B,) batch of typed keys
+    (one independent stream per row, so a request's tokens don't
+    depend on which other requests share its batch); with a single
+    key it splits once and samples all rows from the same stream."""
     import jax
     import jax.numpy as jnp
 
     logits_i = logits_i.astype(jnp.float32)
+    if any(getattr(x, "ndim", 0) >= 1 for x in (temperature, top_k, top_p)):
+        return _sample_token_rows(logits_i, rng, temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
     if temperature == 0.0:
         return jnp.argmax(logits_i, axis=-1).astype(jnp.int32), rng
     logits_i = logits_i / temperature
@@ -58,6 +69,85 @@ def _sample_token(logits_i, rng, *, temperature: float, top_k: int,
         logits_i = jnp.where(keep, logits_i, -1e30)
     rng, sub = jax.random.split(rng)
     return jax.random.categorical(sub, logits_i).astype(jnp.int32), rng
+
+
+def _sample_token_rows(logits_i, rng, *, temperature, top_k, top_p):
+    """Vectorized per-row variant of _sample_token: every parameter is
+    broadcast to (B,) and each row is filtered/sampled under its own
+    settings. Rows with temperature == 0 take argmax of the RAW logits
+    (identical to the scalar greedy contract, and independent of the
+    other rows' parameters). Branches become masks — one compiled shape
+    serves every parameter mix, which is what bounds the serve engine's
+    compile count.
+
+    Costs one full-vocab argsort per call — the descending permutation
+    is shared by the per-row kth threshold (lax.top_k needs a static k;
+    per-row k does not have one) and the nucleus cumsum. Fine at test
+    vocabs; at GPT-2's 50k vocab it is the first thing to optimize if
+    decode-step profiles say so."""
+    import jax
+    import jax.numpy as jnp
+
+    B, V = logits_i.shape
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+
+    greedy = jnp.argmax(logits_i, axis=-1).astype(jnp.int32)
+    x = logits_i / jnp.where(t > 0, t, 1.0)[:, None]
+
+    # ONE shared descending permutation serves both filters (the
+    # full-vocab sort is this path's hot cost — see docstring). Top-k
+    # only demotes entries already below the kth threshold to -1e30, so
+    # the pre-filter order still sorts the post-filter array for the
+    # nucleus cumsum.
+    sort_idx = jnp.argsort(-x, axis=-1)
+
+    # Per-row top-k: the kth-largest value is the keep threshold; rows
+    # with k <= 0 (disabled) skip the filter via the mask.
+    srt = jnp.take_along_axis(x, sort_idx, axis=-1)
+    kth = jnp.take_along_axis(srt, (jnp.clip(k, 1, V) - 1)[:, None], axis=-1)
+    x = jnp.where((k[:, None] > 0) & (x < kth), -1e30, x)
+
+    # Per-row nucleus: same construction as the scalar path with p
+    # broadcast per row; p >= 1 rows keep everything exactly (no
+    # reliance on cumsum rounding), p <= 0 rows degrade to top-1.
+    sorted_logits = jnp.take_along_axis(x, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = ((mass_before < p[:, None]) |
+                   (p[:, None] >= 1.0)).at[:, 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(B)[:, None], sort_idx].set(keep_sorted)
+    x = jnp.where(keep, x, -1e30)
+
+    if _is_key_batch(rng):
+        sampled = jax.vmap(jax.random.categorical)(rng, x).astype(jnp.int32)
+    else:
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(sub, x).astype(jnp.int32)
+    return jnp.where(t == 0.0, greedy, sampled), rng
+
+
+def _is_key_batch(rng) -> bool:
+    """True when rng is a (B,) batch of typed PRNG keys (vs one key)."""
+    import jax
+
+    try:
+        return (jax.dtypes.issubdtype(rng.dtype, jax.dtypes.prng_key)
+                and rng.ndim == 1)
+    except (AttributeError, TypeError):
+        return False
+
+
+def resolve_start(start: str) -> str:
+    """nanoGPT's --start convention: 'FILE:<path>' reads the prompt from a
+    file (verbatim, trailing newline included); anything else is the
+    prompt text itself."""
+    if start.startswith("FILE:"):
+        with open(start[len("FILE:"):], "r", encoding="utf-8") as f:
+            return f.read()
+    return start
 
 
 def generate(model, params, idx, max_new_tokens: int, *, temperature: float,
@@ -162,7 +252,9 @@ def main(argv: list[str] | None = None) -> list[str]:
     ap.add_argument("--out_dir", default="out")
     ap.add_argument("--data_dir", default="data")
     ap.add_argument("--dataset", default="shakespeare_char")
-    ap.add_argument("--start", default="\n")
+    ap.add_argument("--start", default="\n",
+                    help="prompt text, or FILE:<path> to read it from a "
+                         "file (nanoGPT convention)")
     ap.add_argument("--num_samples", type=int, default=1)
     ap.add_argument("--max_new_tokens", type=int, default=200)
     ap.add_argument("--temperature", type=float, default=0.8)
@@ -171,6 +263,13 @@ def main(argv: list[str] | None = None) -> list[str]:
                     help="nucleus sampling mass (1.0 disables)")
     ap.add_argument("--seed", type=int, default=1337)
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    if args.num_samples < 1:
+        # Validate BEFORE the checkpoint restore below: a bad flag should
+        # fail in milliseconds, not after loading a model.
+        ap.error(f"--num_samples must be >= 1, got {args.num_samples}")
+    # Same fail-fast rule for --start=FILE:<path>: a typo'd path must not
+    # cost the user a full model restore before erroring.
+    start_text = resolve_start(args.start)
 
     import jax
     import jax.numpy as jnp
@@ -187,7 +286,7 @@ def main(argv: list[str] | None = None) -> list[str]:
     ds = BinDataset(args.data_dir, args.dataset)
     meta = ds.meta
     tok = get_tokenizer(meta.get("kind", "char"), meta)
-    start_ids = tok.encode(args.start) or [0]
+    start_ids = tok.encode(start_text) or [0]
 
     idx = jnp.asarray([start_ids] * args.num_samples, jnp.int32)
     rng = jax.random.key(args.seed)

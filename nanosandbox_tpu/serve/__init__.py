@@ -1,0 +1,26 @@
+"""Continuous-batching inference: slot KV pool + fixed-shape scheduler.
+
+The training half of the nanoGPT capability surface lives in train.py;
+this package is the serving half the ROADMAP's "heavy traffic" north
+star needs. sample.py jits one fixed-shape generate per invocation and
+serves exactly one prompt shape at a time; batch-1 decode is
+weight-read-bound (the whole parameter set streams from HBM per token),
+so multiplexing many requests through ONE compiled decode step is the
+single largest throughput lever on TPU.
+
+Pieces:
+  scheduler.py — SlotScheduler: FIFO queue, free-slot pool, prefill
+                 bucket ladder (the fixed-shape admission policy).
+  engine.py    — Engine: slot-based KV cache pool, bucketed prefill,
+                 batched per-row decode, submit()/step()/drain().
+  http.py      — EngineLoop (background stepping thread) + a stdlib
+                 ThreadingHTTPServer frontend.
+  __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
+                 checkpoint and serve it.
+"""
+
+from nanosandbox_tpu.serve.engine import Engine, Request, Result
+from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
+
+__all__ = ["Engine", "Request", "Result", "SlotScheduler",
+           "default_buckets"]

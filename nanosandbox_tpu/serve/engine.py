@@ -1,0 +1,335 @@
+"""Continuous-batching decode engine over a slot-based KV cache pool.
+
+Design (the TPU fixed-shape discipline, end to end):
+
+  * One per-layer KV pool of shape (num_slots, H, max_len, D)
+    (models/gpt.py init_cache with batch = num_slots). Each in-flight
+    request OWNS one slot row for its lifetime; eviction is just
+    returning the row to the free list — no copies, the next occupant's
+    prefill overwrites it and the per-row causal mask hides any stale
+    tail.
+
+  * Prefill: a request admitted into a slot runs the model once over
+    its prompt padded to a bucket length (scheduler ladder), writing
+    the bucket's K/V columns into the slot row and sampling the first
+    token from the TRUE last prompt position. One compiled program per
+    bucket, ever.
+
+  * Decode: every step runs the model on (num_slots, 1) tokens with a
+    PER-ROW cache_index vector (models/gpt.py per-row frontier path) —
+    active rows each at their own position, idle rows riding along as
+    padding whose outputs are ignored. Exactly one compiled decode
+    program regardless of the request mix.
+
+  * Sampling is per-row (_sample_token with (S,) parameter vectors) and
+    per-row keyed: the token at position q of request r is sampled with
+    fold_in(key(r.seed), q), so a request's output stream is a pure
+    function of (params, prompt, settings, seed) — independent of which
+    other requests happen to share its batch. That invariant is what
+    makes continuous batching testable against single-request
+    sample.generate token-for-token.
+
+The engine is synchronous and single-threaded by design (one step() ==
+one decode dispatch + one host sync for the sampled tokens); http.py
+wraps it in a background thread for concurrent clients.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
+
+
+@dataclass(frozen=True)
+class Request:
+    """One generation request, in token-id space (the HTTP layer owns
+    text <-> tokens)."""
+    rid: int
+    prompt: tuple
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+
+
+@dataclass
+class Result:
+    rid: int
+    prompt: tuple
+    tokens: List[int]          # generated ids (includes the eos hit, if any)
+    finish_reason: str         # 'length' | 'eos'
+
+
+@dataclass
+class _Active:
+    req: Request
+    slot: int
+    tokens: List[int] = field(default_factory=list)
+
+
+class Engine:
+    """submit() / step() / drain() continuous-batching engine.
+
+    Parameters
+    ----------
+    model, params : the flax GPT and its (cast) params — exactly what
+        sample.generate takes, so one checkpoint serves both paths.
+    num_slots : concurrent request capacity (the decode batch).
+    max_len : per-slot KV length; prompt + new tokens must fit. Capped
+        at block_size (wpe defines no positions past it).
+    prefill_buckets : padded prompt lengths to compile; default is the
+        power-of-two ladder up to max_len.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None):
+        import jax
+
+        from nanosandbox_tpu.models.gpt import init_cache
+
+        cfg = model.cfg
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = min(max_len or cfg.block_size, cfg.block_size)
+        buckets = (sorted(b for b in prefill_buckets if b <= self.max_len)
+                   if prefill_buckets else default_buckets(self.max_len))
+        if not buckets:
+            raise ValueError("no prefill bucket fits within max_len "
+                             f"{self.max_len}: {prefill_buckets!r}")
+        self.sched = SlotScheduler(num_slots, buckets)
+
+        self._pool = init_cache(cfg, num_slots, self.max_len)
+        # Per-slot device-step operands, mirrored host-side as numpy so
+        # admission/eviction are plain array writes. Idle rows keep
+        # harmless values (pos 0, temperature 0): they decode garbage
+        # into their own slot row, which the next prefill overwrites.
+        self._pos = np.zeros(num_slots, np.int32)
+        self._tok = np.zeros(num_slots, np.int32)
+        self._temp = np.zeros(num_slots, np.float32)
+        self._topk = np.zeros(num_slots, np.int32)
+        self._topp = np.ones(num_slots, np.float32)
+        self._seed = np.zeros(num_slots, np.int32)
+
+        self._active: Dict[int, _Active] = {}        # slot -> state
+        self._pending_results: List[Result] = []     # max_new_tokens == 0
+        self._rid = itertools.count()
+        self.steps = 0
+        self.admitted = 0
+        self.completed = 0
+        # Trace-time side-effect counters: each retrace of a step
+        # function bumps these, so a shape leak (e.g. a Python scalar
+        # specializing a trace) shows up as a failing compile-budget
+        # assert instead of a silent 10x serving slowdown.
+        self.trace_counts = {"prefill": 0, "decode": 0}
+
+        # CPU jit ignores donation (and warns); only donate the pool on
+        # accelerators, where reusing the KV buffers in place matters.
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # compiled step functions
+    # ------------------------------------------------------------------
+    def _prefill_fn(self, params, pool, prompt, true_len, slot,
+                    temp, top_k, top_p, seed):
+        """Prompt (1, L_bucket) -> (new pool, first sampled token (1,)).
+
+        Runs the ordinary scalar-cache prefill on a batch-1 temp cache of
+        the bucket length, then writes those columns into the slot's pool
+        row. Positions >= true_len hold garbage K/V — decode overwrites
+        each position before attending to it and the per-row mask hides
+        the rest, so padding never leaks into any output (the greedy
+        parity test pins this)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from nanosandbox_tpu.models.gpt import init_cache
+        from nanosandbox_tpu.sample import _sample_token
+
+        self.trace_counts["prefill"] += 1
+        L = prompt.shape[1]
+        cache = init_cache(self.cfg, 1, L)
+        logits, cache = self.model.apply({"params": params}, prompt,
+                                         deterministic=True, cache=cache,
+                                         cache_index=0)
+        new_pool = []
+        for (pk, pv), (ck, cv) in zip(pool, cache):
+            pk = lax.dynamic_update_slice(pk, ck, (slot, 0, 0, 0))
+            pv = lax.dynamic_update_slice(pv, cv, (slot, 0, 0, 0))
+            new_pool.append((pk, pv))
+        last = logits[0, true_len - 1, :]
+        # Token destined for position true_len: fold_in(seed, true_len) —
+        # the same stream the decode step continues at true_len + 1.
+        key = jax.random.fold_in(jax.random.key(seed), true_len)
+        tok, _ = _sample_token(last[None, :], key[None],
+                               temperature=temp, top_k=top_k, top_p=top_p)
+        return new_pool, tok[0]
+
+    def _decode_fn(self, params, pool, tokens, pos, temps, top_ks, top_ps,
+                   seeds):
+        """One batched token step over ALL slots at per-row frontiers."""
+        import jax
+
+        from nanosandbox_tpu.sample import _sample_token
+
+        self.trace_counts["decode"] += 1
+        logits, pool = self.model.apply({"params": params}, tokens[:, None],
+                                        deterministic=True, cache=pool,
+                                        cache_index=pos)
+        keys = jax.vmap(
+            lambda s, q: jax.random.fold_in(jax.random.key(s), q)
+        )(seeds, pos + 1)
+        nxt, _ = _sample_token(logits[:, 0, :], keys, temperature=temps,
+                               top_k=top_ks, top_p=top_ps)
+        return pool, nxt
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               seed: int = 0, eos_id: Optional[int] = None) -> int:
+        """Queue one request; returns its id. Fixed-shape admission rules
+        are enforced here so a bad request fails at submit, not as a
+        mid-flight surprise."""
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt (encode at least one token)")
+        if max_new_tokens < 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        if len(prompt) > self.sched.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {self.sched.buckets[-1]}")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the per-slot KV "
+                f"length {self.max_len}; long-context decode belongs to "
+                "sample.py's windowed path")
+        rid = next(self._rid)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), seed=int(seed), eos_id=eos_id)
+        if max_new_tokens == 0:
+            self._pending_results.append(
+                Result(rid=rid, prompt=prompt, tokens=[],
+                       finish_reason="length"))
+            return rid
+        self.sched.enqueue(req)
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self._active or self.sched.queued
+                    or self._pending_results)
+
+    def step(self) -> List[Result]:
+        """Admit as many queued requests as slots allow (prefill +
+        first token), then run one batched decode step over every slot.
+        Returns the requests that finished during this step."""
+        import jax.numpy as jnp
+
+        finished, self._pending_results = self._pending_results, []
+
+        # Backfill free slots mid-flight; a request finishing on its
+        # prefill token immediately frees its slot for the next in line.
+        while (adm := self.sched.next_admission()) is not None:
+            req, slot, bucket = adm
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(req.prompt)] = req.prompt
+            self._pool, tok0 = self._prefill(
+                self.params, self._pool, jnp.asarray(padded),
+                jnp.asarray(len(req.prompt), jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jnp.asarray(req.seed, jnp.int32))
+            self.admitted += 1
+            state = _Active(req=req, slot=slot, tokens=[int(tok0)])
+            self._pos[slot] = len(req.prompt)
+            self._tok[slot] = state.tokens[-1]
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._seed[slot] = req.seed
+            self._active[slot] = state
+            done = self._maybe_finish(state)
+            if done is not None:
+                finished.append(done)
+
+        if self._active:
+            self._pool, nxt = self._decode(
+                self.params, self._pool,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._seed))
+            self.steps += 1
+            nxt = np.asarray(nxt)
+            for slot, state in list(self._active.items()):
+                state.tokens.append(int(nxt[slot]))
+                self._pos[slot] += 1
+                self._tok[slot] = int(nxt[slot])
+                done = self._maybe_finish(state)
+                if done is not None:
+                    finished.append(done)
+        return finished
+
+    def drain(self) -> List[Result]:
+        """Run step() until queue and slots are empty; all results."""
+        out: List[Result] = []
+        while self.has_work():
+            out.extend(self.step())
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "num_slots": self.num_slots,
+            "max_len": self.max_len,
+            "prefill_buckets": list(self.sched.buckets),
+            "active": len(self._active),
+            "queued": self.sched.queued,
+            "free_slots": self.sched.free_slots,
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "decode_steps": self.steps,
+            "trace_counts": dict(self.trace_counts),
+        }
+
+    # ------------------------------------------------------------------
+    def _maybe_finish(self, state: _Active) -> Optional[Result]:
+        req = state.req
+        reason = None
+        if req.eos_id is not None and state.tokens[-1] == req.eos_id:
+            reason = "eos"
+        elif len(state.tokens) >= req.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return None
+        del self._active[state.slot]
+        self.sched.release(state.slot)
+        # Park the idle row at a harmless frontier; its garbage decode
+        # writes stay inside its own slot row.
+        self._pos[state.slot] = 0
+        self._tok[state.slot] = 0
+        self._temp[state.slot] = 0.0
+        self._topk[state.slot] = 0
+        self._topp[state.slot] = 1.0
+        self._seed[state.slot] = 0
+        self.completed += 1
+        return Result(rid=req.rid, prompt=req.prompt, tokens=state.tokens,
+                      finish_reason=reason)

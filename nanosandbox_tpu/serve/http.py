@@ -1,0 +1,203 @@
+"""Thin stdlib HTTP frontend over the continuous-batching Engine.
+
+The Engine is single-threaded by design; EngineLoop is the ONE thread
+that touches it. HTTP handler threads (ThreadingHTTPServer) hand
+submissions to the loop through a mutex-guarded inbox and block on a
+per-request Event until their tokens come back — so N concurrent
+clients become N rows of the same batched decode step, which is the
+entire point of the subsystem.
+
+No external web framework: the repo's dependency budget is "what the
+image already ships", and http.server is plenty for a JSON
+POST /generate + GET /healthz surface. Anything fancier (streaming,
+cancellation) belongs behind the same EngineLoop seam.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class _Pending:
+    def __init__(self, kwargs: dict):
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class EngineLoop(threading.Thread):
+    """Background thread that owns the Engine: drains the submission
+    inbox, steps while any request is in flight, sleeps otherwise."""
+
+    def __init__(self, engine):
+        super().__init__(daemon=True, name="serve-engine-loop")
+        self.engine = engine
+        self._cond = threading.Condition()
+        self._inbox: list[_Pending] = []
+        self._by_rid: dict[int, _Pending] = {}
+        self._stopping = False
+        # Set when the loop dies on an engine error: /healthz keys off it
+        # so a wedged engine flips the pod NotReady (and the liveness
+        # probe restarts it) instead of serving 504s behind a green check.
+        self.dead: Optional[str] = None
+
+    def submit(self, **kwargs) -> _Pending:
+        """Thread-safe: queue a request for the loop thread; returns a
+        pending handle whose .done fires when generation finishes."""
+        p = _Pending(kwargs)
+        with self._cond:  # dead-check under the lock: no append race
+            if self.dead is not None:
+                p.error = RuntimeError(f"engine loop died: {self.dead}")
+                p.done.set()
+            else:
+                self._inbox.append(p)
+                self._cond.notify()
+        return p
+
+    def generate(self, timeout: Optional[float] = None, **kwargs):
+        """submit + wait; raises the engine's validation error if any."""
+        p = self.submit(**kwargs)
+        if not p.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify()
+
+    def run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._stopping and not self._inbox
+                       and not self.engine.has_work()):
+                    self._cond.wait()
+                if self._stopping:
+                    self._fail_all(RuntimeError("server shutting down"))
+                    return
+                inbox, self._inbox = self._inbox, []
+            for p in inbox:
+                try:
+                    rid = self.engine.submit(**p.kwargs)
+                    self._by_rid[rid] = p
+                except Exception as e:  # validation error -> the caller
+                    p.error = e
+                    p.done.set()
+            try:
+                results = self.engine.step()
+            except Exception as e:
+                # An engine failure (device OOM, compile error) wedges
+                # every in-flight slot: fail ALL waiters immediately
+                # instead of letting them block to timeout, mark the loop
+                # dead so health checks go red, and exit.
+                self.dead = f"{type(e).__name__}: {e}"
+                with self._cond:
+                    self._fail_all(RuntimeError(
+                        f"engine loop died: {self.dead}"))
+                raise
+            for res in results:
+                p = self._by_rid.pop(res.rid, None)
+                if p is not None:
+                    p.result = res
+                    p.done.set()
+
+    def _fail_all(self, err: Exception) -> None:
+        """Signal every waiter — queued AND mid-generation (call with
+        self._cond held, or from the dying loop thread)."""
+        for p in self._inbox:
+            p.error = err
+            p.done.set()
+        self._inbox = []
+        for p in self._by_rid.values():
+            p.error = err
+            p.done.set()
+        self._by_rid = {}
+
+
+def make_server(host: str, port: int, loop: EngineLoop,
+                encode: Callable[[str], list],
+                decode: Callable[[list], str],
+                request_timeout: float = 300.0) -> ThreadingHTTPServer:
+    """HTTP server bound to an EngineLoop.
+
+    POST /generate  {"prompt": str | "prompt_tokens": [int], and any of
+                     max_new_tokens, temperature, top_k, top_p, seed,
+                     eos_id}  ->  {"id", "tokens", "text",
+                     "finish_reason"}
+    GET  /healthz   -> {"ok": true}
+    GET  /stats     -> engine counters (slots, queue, compiles, ...)
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # stdout stays metrics-only
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if loop.dead is not None or not loop.is_alive():
+                    self._json(503, {"ok": False,
+                                     "error": loop.dead or "loop not running"})
+                else:
+                    self._json(200, {"ok": True})
+            elif self.path == "/stats":
+                self._json(200, loop.engine.stats())
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if "prompt_tokens" in payload:
+                    prompt = [int(t) for t in payload["prompt_tokens"]]
+                else:
+                    prompt = encode(str(payload.get("prompt", ""))) or [0]
+                kwargs = dict(
+                    prompt=prompt,
+                    max_new_tokens=int(payload.get("max_new_tokens", 64)),
+                    temperature=float(payload.get("temperature", 0.8)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    seed=int(payload.get("seed", 0)),
+                )
+                if payload.get("eos_id") is not None:
+                    kwargs["eos_id"] = int(payload["eos_id"])
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                res = loop.generate(timeout=request_timeout, **kwargs)
+            except ValueError as e:       # engine admission rules
+                self._json(400, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                self._json(504, {"error": str(e)})
+                return
+            except RuntimeError as e:     # engine loop died / shutdown
+                self._json(503, {"error": str(e)})
+                return
+            self._json(200, {
+                "id": res.rid,
+                "tokens": res.tokens,
+                "text": decode(list(res.prompt) + res.tokens),
+                "finish_reason": res.finish_reason,
+            })
+
+    return ThreadingHTTPServer((host, port), Handler)

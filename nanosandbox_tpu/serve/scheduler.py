@@ -1,0 +1,98 @@
+"""Fixed-shape admission control for the continuous-batching engine.
+
+TPU programs are compiled per shape, so the scheduler's job is to make
+an arbitrary request stream look like a SMALL, CLOSED set of shapes:
+
+  * decode always runs the full (num_slots,) batch — idle slots ride
+    along as padding rows whose outputs are ignored (one compiled
+    decode step, ever);
+  * prefill pads each prompt up to a bucket from a fixed ladder, so at
+    most len(buckets) prefill programs exist no matter what lengths
+    arrive.
+
+Everything here is plain host-side Python (no jax import): it must be
+cheap enough to run between every decode step and testable without a
+device.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+
+def default_buckets(max_len: int, min_bucket: int = 16) -> List[int]:
+    """Power-of-two prefill ladder capped at max_len: 16, 32, ... max_len.
+
+    Doubling bounds padding waste at <2x while keeping the compile set
+    logarithmic in max_len — the standard fixed-shape serving trade.
+    max_len itself is always the last rung so every admissible prompt
+    (length <= max_len) has a bucket."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    buckets: List[int] = []
+    b = min(min_bucket, max_len)
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+class SlotScheduler:
+    """FIFO queue + free-slot pool + bucket ladder.
+
+    Owns no device state: the Engine asks it which request goes into
+    which slot (``next_admission``) and tells it when a slot frees
+    (``release``). FIFO keeps admission starvation-free — a long prompt
+    at the head is never jumped by later short ones, matching the
+    reference trainer's strictly-ordered batch semantics rather than a
+    throughput-greedy reorder."""
+
+    def __init__(self, num_slots: int, buckets: List[int]):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if not buckets or sorted(buckets) != list(buckets):
+            raise ValueError(f"buckets must be a sorted non-empty list, "
+                             f"got {buckets!r}")
+        self.num_slots = num_slots
+        self.buckets = list(buckets)
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        self._queue: Deque = deque()
+
+    # -- queue side --
+    def enqueue(self, item) -> None:
+        self._queue.append(item)
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest ladder rung >= prompt_len."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.buckets[-1]}")
+
+    def next_admission(self) -> Optional[Tuple[object, int, int]]:
+        """(queued item, slot, prefill bucket) when both a queued request
+        and a free slot exist, else None. Pops both."""
+        if not self._queue or not self._free:
+            return None
+        item = self._queue.popleft()
+        slot = self._free.pop()
+        return item, slot, self.bucket_for(len(item.prompt))
+
+    def release(self, slot: int) -> None:
+        if slot in self._free:
+            raise ValueError(f"slot {slot} released twice")
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        self._free.append(slot)

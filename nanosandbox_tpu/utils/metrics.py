@@ -14,8 +14,25 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from typing import Any
+
+_WARNED_ONCE: set[str] = set()
+
+
+def warn_once(key: str, msg: str) -> None:
+    """Process-wide one-shot warning to stderr, deduplicated by ``key``.
+
+    For conditions that are expected exactly once per run but alarming
+    when repeated (e.g. Checkpointer.save skipping an already-saved step
+    right after resume): the first occurrence is logged so the run
+    doesn't LOOK like it silently stopped doing the thing, repeats stay
+    quiet so a hot loop can't flood the log."""
+    if key in _WARNED_ONCE:
+        return
+    _WARNED_ONCE.add(key)
+    print(msg, file=sys.stderr, flush=True)
 
 
 class MetricsWriter:

@@ -43,6 +43,23 @@ def test_duplicate_step_is_noop(tiny_cfg):
     ckpt.close()
 
 
+def test_duplicate_step_save_logs_skip_once(tmp_path, capsys):
+    """A skipped re-save (resume re-evals at the restored step) must say
+    so ONCE on stderr — a resumed run that never logs a save otherwise
+    looks like checkpointing silently stopped — and must not repeat on
+    every subsequent eval_interval hit."""
+    state = {"w": np.zeros((2, 2), np.float32)}
+    ckpt = Checkpointer(str(tmp_path / "out"), keep=2)
+    ckpt.save(1, state, wait=True)
+    capsys.readouterr()  # drop orbax's own chatter from the first save
+    ckpt.save(1, state, wait=True)
+    ckpt.save(1, state, wait=True)
+    err = capsys.readouterr().err
+    assert err.count("already exists") == 1, err
+    assert "skipping save" in err
+    ckpt.close()
+
+
 def test_abstract_like(tiny_cfg):
     trainer = Trainer(tiny_cfg)
     state = trainer.init_state()

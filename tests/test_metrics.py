@@ -59,3 +59,15 @@ def test_disabled_writer_is_inert(tmp_path):
     w.log(0, {"x": 1})
     w.close()
     assert not os.listdir(tmp_path)
+
+
+def test_warn_once_dedupes_by_key(capsys):
+    from nanosandbox_tpu.utils.metrics import warn_once
+
+    warn_once("test-metrics-key-a", "message A")
+    warn_once("test-metrics-key-a", "message A again")
+    warn_once("test-metrics-key-b", "message B")
+    err = capsys.readouterr().err
+    assert err.count("message A") == 1
+    assert "again" not in err
+    assert "message B" in err

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from nanosandbox_tpu.config import GPTConfig
 from nanosandbox_tpu.models.gpt import GPT, init_cache
@@ -166,3 +167,105 @@ def test_top_p_zero_keeps_top1():
         tok, rng = _sample_token(logits, rng, temperature=1.0, top_k=0,
                                  top_p=0.0)
         assert int(tok[0]) == 0
+
+
+# ------------------------------------------------- per-row sampling (serve)
+
+def test_sample_token_per_row_greedy_and_topk1():
+    """Vector params: a temperature=0 row takes argmax of the RAW logits;
+    a top_k=1 row is argmax via filtering — both deterministic, each row
+    governed only by its own settings."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05],
+                                  [0.05, 0.15, 0.3, 0.5]]))
+    for _ in range(20):
+        tok, _ = _sample_token(logits, jax.random.key(0),
+                               temperature=jnp.asarray([0.0, 1.0]),
+                               top_k=jnp.asarray([0, 1]),
+                               top_p=jnp.asarray([1.0, 1.0]))
+        assert int(tok[0]) == 0   # greedy row
+        assert int(tok[1]) == 3   # top-1-filtered row
+
+
+def test_sample_token_per_row_top_p_masks_per_row():
+    """Row 0 (p=0.6) may only emit tokens {0, 1}; row 1 (p=1.0) of the
+    same distribution eventually emits the tail too."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    row = [0.5, 0.3, 0.15, 0.05]
+    logits = jnp.log(jnp.asarray([row, row]))
+    seen0, seen1 = set(), set()
+    rng = jax.random.key(0)
+    for _ in range(300):
+        rng, sub = jax.random.split(rng)
+        tok, _ = _sample_token(logits, sub,
+                               temperature=jnp.asarray([1.0, 1.0]),
+                               top_k=jnp.asarray([0, 0]),
+                               top_p=jnp.asarray([0.6, 1.0]))
+        seen0.add(int(tok[0]))
+        seen1.add(int(tok[1]))
+    assert seen0 == {0, 1}, seen0
+    assert seen1 == {0, 1, 2, 3}, seen1
+
+
+def test_sample_token_per_row_key_batch_isolates_rows():
+    """With a (B,) key batch, each row samples from its own stream: the
+    same key must yield the same token no matter what other rows ride
+    along — the engine's batch-composition-independence anchor."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    row = [0.25, 0.25, 0.25, 0.25]
+    keys1 = jnp.stack([jax.random.key(5)])
+    keys3 = jnp.stack([jax.random.key(5), jax.random.key(6),
+                       jax.random.key(7)])
+    t1, _ = _sample_token(jnp.log(jnp.asarray([row])), keys1,
+                          temperature=jnp.asarray([1.0]),
+                          top_k=jnp.asarray([0]), top_p=jnp.asarray([1.0]))
+    t3, _ = _sample_token(jnp.log(jnp.asarray([row] * 3)), keys3,
+                          temperature=jnp.ones(3), top_k=jnp.zeros(3, jnp.int32),
+                          top_p=jnp.ones(3))
+    assert int(t1[0]) == int(t3[0])
+
+
+def test_sample_token_scalar_path_unchanged_by_vector_dispatch():
+    """A (B,)-broadcast of identical scalar params filters identically to
+    the scalar path: with top_k=2 both paths can only emit {0, 1}."""
+    from nanosandbox_tpu.sample import _sample_token
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    seen = set()
+    rng = jax.random.key(3)
+    for _ in range(100):
+        rng, sub = jax.random.split(rng)
+        tok, _ = _sample_token(logits, sub,
+                               temperature=jnp.asarray([1.0]),
+                               top_k=jnp.asarray([2]),
+                               top_p=jnp.asarray([1.0]))
+        seen.add(int(tok[0]))
+    assert seen == {0, 1}, seen
+
+
+# ------------------------------------------------------ CLI parity (main)
+
+def test_main_rejects_num_samples_below_one(tmp_path):
+    """--num_samples=0 must fail fast (argparse error), BEFORE any
+    checkpoint restore is attempted — the bogus out_dir would raise a
+    different error if validation ran late."""
+    from nanosandbox_tpu.sample import main
+
+    with pytest.raises(SystemExit) as ei:
+        main(["--num_samples=0", f"--out_dir={tmp_path}/definitely-missing"])
+    assert ei.value.code == 2  # argparse error exit, not FileNotFoundError
+
+
+def test_resolve_start_file_convention(tmp_path):
+    """nanoGPT's --start=FILE:<path> reads the prompt from a file."""
+    from nanosandbox_tpu.sample import resolve_start
+
+    p = tmp_path / "prompt.txt"
+    p.write_text("To be, or not to be\n")
+    assert resolve_start(f"FILE:{p}") == "To be, or not to be\n"
+    assert resolve_start("plain text") == "plain text"
+    with pytest.raises(FileNotFoundError):
+        resolve_start(f"FILE:{tmp_path}/nope.txt")

@@ -1,0 +1,312 @@
+"""Serve-engine tests: continuous batching, slot KV pool, fixed shapes.
+
+The contract under test (ISSUE 1 acceptance bar):
+  * >= 8 concurrent mixed-length requests on CPU, each token-for-token
+    identical to single-request sample.generate under greedy decoding;
+  * a bounded compile set — at most one program per prefill bucket plus
+    ONE decode shape, asserted via the engine's trace counters;
+  * mid-flight backfill: more requests than slots all complete;
+  * per-request determinism independent of batch composition (per-row
+    keyed sampling).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT
+from nanosandbox_tpu.sample import generate
+from nanosandbox_tpu.serve import Engine, SlotScheduler, default_buckets
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _ref_greedy(model, params, prompt, max_new, block_size):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32), max_new,
+                   temperature=0.0, top_k=0, rng=jax.random.key(0),
+                   block_size=block_size)
+    return [int(t) for t in out[0, len(prompt):]]
+
+
+# ---------------------------------------------------------------- scheduler
+
+def test_default_buckets_ladder():
+    assert default_buckets(64) == [16, 32, 64]
+    assert default_buckets(100) == [16, 32, 64, 100]
+    assert default_buckets(8) == [8]
+    with pytest.raises(ValueError, match="max_len"):
+        default_buckets(0)
+
+
+def test_scheduler_admission_and_release():
+    class Item:
+        def __init__(self, n):
+            self.prompt = [0] * n
+
+    s = SlotScheduler(2, [8, 16])
+    assert s.next_admission() is None  # nothing queued
+    s.enqueue(Item(5))
+    s.enqueue(Item(9))
+    s.enqueue(Item(3))
+    a = s.next_admission()
+    b = s.next_admission()
+    assert a[2] == 8 and b[2] == 16  # FIFO order, smallest fitting bucket
+    assert a[1] != b[1]
+    assert s.next_admission() is None  # both slots busy
+    s.release(a[1])
+    c = s.next_admission()
+    assert c[1] == a[1] and c[2] == 8
+    s.release(b[1])
+    with pytest.raises(ValueError, match="twice"):
+        s.release(b[1])
+
+
+def test_scheduler_rejects_oversized_prompt():
+    s = SlotScheduler(1, [8])
+    with pytest.raises(ValueError, match="exceeds"):
+        s.bucket_for(9)
+
+
+# ------------------------------------------------------------------- engine
+
+def test_single_request_greedy_matches_sample_generate(served_model):
+    """The ISSUE's parity anchor: engine output for one request ==
+    sample.generate token-for-token under greedy decoding."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    prompt = [1, 2, 3, 4, 5]
+    rid = eng.submit(prompt, 15)
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid].tokens == _ref_greedy(model, params, prompt, 15,
+                                          cfg.block_size)
+    assert res[rid].finish_reason == "length"
+
+
+def test_eight_concurrent_mixed_lengths_parity_and_compile_budget(
+        served_model):
+    """Acceptance: >= 8 concurrent mixed-length requests, per-request
+    greedy parity with sample.generate, and a compile set bounded by
+    #prefill-buckets + 1 decode shape."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=8, max_len=64)
+    rng = np.random.default_rng(7)
+    reqs = []
+    for _ in range(8):
+        L = int(rng.integers(1, 30))
+        prompt = [int(x) for x in rng.integers(0, cfg.vocab_size, L)]
+        mnt = int(rng.integers(1, 16))
+        reqs.append((eng.submit(prompt, mnt), prompt, mnt))
+    assert eng.stats()["queued"] == 8
+
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res) == 8
+    for rid, prompt, mnt in reqs:
+        assert res[rid].tokens == _ref_greedy(model, params, prompt, mnt,
+                                              cfg.block_size), rid
+
+    n_buckets = len(eng.sched.buckets)
+    assert eng.trace_counts["decode"] == 1
+    assert eng.trace_counts["prefill"] <= n_buckets
+    assert sum(eng.trace_counts.values()) <= n_buckets + 1
+
+
+def test_backfill_more_requests_than_slots(served_model):
+    """Continuous batching proper: 10 requests through 3 slots, evicted
+    rows backfilled mid-flight, every output still exact."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=3, max_len=64)
+    rng = np.random.default_rng(11)
+    reqs = []
+    for _ in range(10):
+        L = int(rng.integers(1, 20))
+        prompt = [int(x) for x in rng.integers(0, cfg.vocab_size, L)]
+        mnt = int(rng.integers(1, 10))
+        reqs.append((eng.submit(prompt, mnt), prompt, mnt))
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res) == 10
+    assert eng.stats()["admitted"] == 10
+    assert eng.stats()["free_slots"] == 3
+    for rid, prompt, mnt in reqs:
+        assert res[rid].tokens == _ref_greedy(model, params, prompt, mnt,
+                                              cfg.block_size), rid
+
+
+def test_eos_evicts_early(served_model):
+    """A request whose eos_id is the first greedy token stops after one
+    token with finish_reason='eos' and frees its slot."""
+    cfg, model, params = served_model
+    prompt = [3, 1, 4]
+    first = _ref_greedy(model, params, prompt, 1, cfg.block_size)[0]
+    eng = Engine(model, params, num_slots=1, max_len=64)
+    rid = eng.submit(prompt, 20, eos_id=first)
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid].tokens == [first]
+    assert res[rid].finish_reason == "eos"
+    assert eng.stats()["free_slots"] == 1
+
+
+def test_sampled_output_independent_of_batch_composition(served_model):
+    """Per-row keyed sampling: a request's tokens are a function of its
+    own (prompt, settings, seed), not of its batch neighbours — the
+    invariant that makes continuous batching deterministic per request."""
+    cfg, model, params = served_model
+
+    def run(prompts):
+        eng = Engine(model, params, num_slots=4, max_len=64)
+        rids = [eng.submit(p, 8, temperature=0.9, top_k=5, top_p=0.95,
+                           seed=100 + i) for i, p in enumerate(prompts)]
+        res = {r.rid: r.tokens for r in eng.drain()}
+        return [res[r] for r in rids]
+
+    solo = run([[1, 2, 3]])[0]
+    crowded = run([[1, 2, 3], [9] * 12, [7, 8], [5, 4, 3, 2, 1]])[0]
+    assert solo == crowded
+
+
+def test_submit_validation(served_model):
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=32)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1], -1)
+    with pytest.raises(ValueError, match="prefill bucket"):
+        eng.submit([1] * 33, 1)
+    with pytest.raises(ValueError, match="per-slot KV length"):
+        eng.submit([1] * 30, 10)
+
+
+def test_max_new_tokens_zero_completes_without_slot(served_model):
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=1, max_len=32)
+    rid = eng.submit([1, 2], 0)
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid].tokens == [] and res[rid].finish_reason == "length"
+    assert eng.stats()["admitted"] == 0  # never took a slot
+
+
+def test_idle_slots_do_not_perturb_active_rows(served_model):
+    """A decode step always runs all num_slots rows; idle/padding rows
+    must not change an active row's tokens (masked frontiers)."""
+    cfg, model, params = served_model
+    prompt = [2, 7, 1, 8]
+    ref = _ref_greedy(model, params, prompt, 12, cfg.block_size)
+    for slots in (1, 4, 8):
+        eng = Engine(model, params, num_slots=slots, max_len=64)
+        rid = eng.submit(prompt, 12)
+        res = {r.rid: r for r in eng.drain()}
+        assert res[rid].tokens == ref, slots
+
+
+# --------------------------------------------------------------------- http
+
+def test_http_frontend_concurrent_roundtrip(served_model):
+    """N concurrent HTTP clients multiplex into one engine batch and get
+    their own results back; bad requests surface as 400s."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from nanosandbox_tpu.serve.http import EngineLoop, make_server
+
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64)
+    loop = EngineLoop(eng)
+    loop.start()
+    encode = lambda s: [min(ord(c), cfg.vocab_size - 1) for c in s]  # noqa: E731
+    decode = lambda ids: " ".join(str(i) for i in ids)  # noqa: E731
+    srv = make_server("127.0.0.1", 0, loop, encode, decode)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(payload).encode())
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        out = {}
+
+        def client(i):
+            out[i] = post({"prompt": "ab" * (i + 1), "max_new_tokens": 4,
+                           "temperature": 0.0})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert all(len(out[i]["tokens"]) == 4 for i in range(6))
+        assert all(out[i]["finish_reason"] == "length" for i in range(6))
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            assert json.loads(r.read())["admitted"] >= 6
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": "x" * 100, "max_new_tokens": 4})
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+def test_engine_loop_failure_fails_waiters_fast():
+    """If the engine dies mid-step, every waiter (queued AND in-flight)
+    is failed immediately — not left to block until timeout — and later
+    submissions fail fast with the death reason."""
+    from nanosandbox_tpu.serve.http import EngineLoop
+
+    class BoomEngine:
+        def submit(self, **kw):
+            return 0
+
+        def has_work(self):
+            return True
+
+        def step(self):
+            raise RuntimeError("boom")
+
+    loop = EngineLoop(BoomEngine())
+    loop.start()
+    p = loop.submit(prompt=[1], max_new_tokens=1)
+    assert p.done.wait(30)
+    assert isinstance(p.error, RuntimeError) and "boom" in str(p.error)
+    loop.join(30)
+    assert loop.dead is not None
+    p2 = loop.submit(prompt=[1], max_new_tokens=1)
+    assert p2.done.is_set() and "boom" in str(p2.error)
+
+
+# -------------------------------------------------------------------- bench
+
+def test_bench_decode_mode_emits_json():
+    import bench
+
+    result = bench.bench_decode({"slots": "2", "max_new_tokens": "3",
+                                 "requests": "3"}, quick=True, on_tpu=False)
+    assert result["unit"] == "tokens/sec"
+    assert result["value"] > 0
+    assert result["extra"]["tokens_generated"] == 9
+    n_buckets = len(result["extra"]["prefill_buckets"])
+    assert sum(result["extra"]["trace_counts"].values()) <= n_buckets + 1
