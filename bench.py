@@ -13,8 +13,11 @@ tokens/sec/chip divided by that estimate (>1.0 beats the reference's
 per-device hardware).
 
 Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
-       python bench.py --mode=decode [--quick] [--slots=N] \
-           [--max_new_tokens=N] [--requests=N]
+       python bench.py --mode=decode [--quick] [--num_slots=N] \
+           [--max_new_tokens=N] [--requests=N] [--mixed=1]
+
+Decode mode reports pipelined AND synchronous tokens/sec (plus TTFT
+percentiles) so the pipelining win is trend-tracked in CI, no threshold.
 """
 
 from __future__ import annotations
@@ -97,20 +100,31 @@ def build_config(kv: dict, *, on_tpu: bool, n_chips: int, tmp: str,
 
 
 def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
-    """Batched-decode tokens/sec through the serve engine.
+    """Batched-decode tokens/sec through the serve engine, pipelined vs
+    synchronous.
 
     Measures the serving metric that matters — aggregate generated
     tokens/sec across a full continuous batch with mixed prompt lengths
-    and mid-flight backfill — not batch-1 latency. Params are randomly
+    and mid-flight backfill — not batch-1 latency. The SAME workload
+    runs twice, once with the synchronous PR-1-style loop (pipeline=
+    False: one host readback per token) and once pipelined (one decode
+    step in flight ahead of the host), so the JSON carries the overlap
+    win as a trend-tracked ratio, no threshold. Params are randomly
     initialized (throughput does not depend on the weights) and cast to
     the serving dtype, exactly as `python -m nanosandbox_tpu.serve`
     casts a restored checkpoint. A warmup drain first touches every
-    prefill bucket so compilation never lands inside the timed window.
+    compiled program so compilation never lands inside a timed window.
+
+    Knobs: --num_slots (alias --slots), --max_new_tokens, --requests,
+    --mixed (vary max_new_tokens per request so finishes stagger and
+    mid-run backfill/eviction dominate — the continuous-batching regime,
+    and the acceptance workload for the pipelining PR).
     """
     import time
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from nanosandbox_tpu.config import GPTConfig
     from nanosandbox_tpu.models.gpt import GPT
@@ -128,35 +142,59 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                         compute_dtype="float32", attention_impl="xla")
         max_len, max_new = 64, (8 if quick else 16)
 
-    num_slots = int(kv.get("slots", 8))
+    num_slots = int(kv.get("num_slots", kv.get("slots", 8)))
     max_new = int(kv.get("max_new_tokens", max_new))
     n_requests = int(kv.get("requests", 2 * num_slots))
+    mixed = "mixed" in kv and kv["mixed"] not in ("0", "false", "no")
 
     model = GPT(cfg)
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     params = cast_params_for_serving(params, cfg.compute_dtype)
-    engine = Engine(model, params, num_slots=num_slots, max_len=max_len)
 
-    rng = __import__("numpy").random.default_rng(0)
-    def submit_mix(n):
-        for i in range(n):
-            # One warmup prompt per bucket rung, then mixed lengths.
-            L = engine.sched.buckets[i % len(engine.sched.buckets)] \
-                if i < len(engine.sched.buckets) else \
-                int(rng.integers(1, max(2, max_len - max_new)))
-            L = min(L, max_len - max_new)
+    def workload(engine, n, seed):
+        """Mixed prompt lengths (drawn per request, same stream for both
+        engines); --mixed also staggers the token budgets."""
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            L = int(rng.integers(1, max(2, max_len - max_new)))
+            mnt = (int(rng.integers(max(1, max_new // 4), max_new + 1))
+                   if mixed else max_new)
             prompt = rng.integers(0, cfg.vocab_size, max(L, 1)).tolist()
-            engine.submit(prompt, max_new)
+            engine.submit(prompt, mnt)
 
-    submit_mix(len(engine.sched.buckets) + 1)  # warmup: compile everything
-    engine.drain()
+    def run(pipeline: bool):
+        engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
+                        pipeline=pipeline)
+        # Warmup: every (wave rung, bucket) prefill + admit + decode +
+        # release program, so no timed window eats an XLA compile. The
+        # prompt length must MAP to the bucket being warmed (in
+        # (previous rung, bucket]); a bucket with no decodable length is
+        # unreachable by the workload too, so skipping it is sound.
+        lo = 1
+        for bucket in engine.sched.buckets:
+            length = min(bucket, max_len - 2)
+            lo, prev_lo = bucket + 1, lo
+            if length < prev_lo:
+                continue
+            for k in engine.admit_buckets:
+                for _ in range(k):
+                    engine.submit([0] * length, 2)
+                engine.drain()
+        # Warmup TTFT/TPOT samples would swamp the workload's in the
+        # rings (45 warmup requests vs 16 timed at the defaults): the
+        # reported percentiles must describe the measured traffic.
+        engine.reset_latency_stats()
+        workload(engine, n_requests, seed=0)
+        t0 = time.perf_counter()
+        results = engine.drain()
+        dt = time.perf_counter() - t0
+        generated = sum(len(r.tokens) for r in results)
+        return engine, generated, dt
 
-    submit_mix(n_requests)
-    t0 = time.perf_counter()
-    results = engine.drain()
-    dt = time.perf_counter() - t0
-    generated = sum(len(r.tokens) for r in results)
+    _, sync_generated, sync_dt = run(pipeline=False)
+    engine, generated, dt = run(pipeline=True)
+    stats = engine.stats()
 
     return {
         "metric": "gpt2_124m_batched_decode_tokens_per_sec" if on_tpu
@@ -170,11 +208,19 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "max_len": max_len,
             "max_new_tokens": max_new,
             "requests": n_requests,
+            "mixed": mixed,
             "tokens_generated": generated,
             "decode_steps": engine.steps,
             "prefill_buckets": list(engine.sched.buckets),
+            "admit_buckets": list(engine.admit_buckets),
             "trace_counts": dict(engine.trace_counts),
             "elapsed_s": dt,
+            "pipelined_tokens_per_sec": generated / dt,
+            "sync_tokens_per_sec": sync_generated / sync_dt,
+            "pipeline_speedup": (generated / dt) / (sync_generated / sync_dt),
+            "ttft_s": stats["ttft_s"],
+            "tpot_s": stats["tpot_s"],
+            "queue_wait_steps_mean": stats["queue_wait_steps_mean"],
         },
     }
 
@@ -182,6 +228,8 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
 def main(argv: list[str]) -> dict:
     quick = "--quick" in argv
     kv = dict(a.lstrip("-").split("=", 1) for a in argv if "=" in a)
+    if "--mixed" in argv:  # bare flag form, like --quick
+        kv.setdefault("mixed", "1")
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -73,6 +73,11 @@ class BinDataset:
 class BatchLoader:
     """Iterator over per-host batches with one-batch-ahead prefetch."""
 
+    # Queue sentinel: the worker died on the exception stored in
+    # self._worker_exc. An object(), not None, so a legitimate batch can
+    # never be mistaken for it.
+    _FAILED = object()
+
     def __init__(self, dataset: BinDataset, split: str, batch_size: int,
                  block_size: int, *, seed: int = 1337, process_index: int = 0,
                  num_processes: int = 1, start_step: int = 0,
@@ -91,6 +96,7 @@ class BatchLoader:
         self.step = start_step
         self.native = native.get_lib() is not None
         self._queue: queue.Queue | None = None
+        self._worker_exc: BaseException | None = None
         if prefetch:
             self._queue = queue.Queue(maxsize=2)
             self._stop = threading.Event()
@@ -102,24 +108,48 @@ class BatchLoader:
             self.split, step, self.local_batch_size, self.block_size,
             seed=self.seed, process_index=self.process_index)
 
+    def _put(self, item) -> None:
+        """Blocking put that still honors close() (bounded queue: a dead
+        consumer must not wedge the worker forever)."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
     def _worker(self) -> None:
         step = self.step
-        while not self._stop.is_set():
-            batch = self._load(step)
+        try:
             while not self._stop.is_set():
-                try:
-                    self._queue.put((step, batch), timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
-            step += 1
+                batch = self._load(step)
+                self._put((step, batch))
+                step += 1
+        except Exception as e:
+            # A worker exception (truncated .bin mid-run, mmap I/O error)
+            # used to kill the thread silently and leave __next__ blocked
+            # forever on an empty queue. Park the exception and push the
+            # sentinel through the queue so the consumer re-raises at its
+            # next (and every later) __next__.
+            self._worker_exc = e
+            self._put(self._FAILED)
 
     def __iter__(self):
         return self
 
     def __next__(self) -> tuple[np.ndarray, np.ndarray]:
         if self._queue is not None:
-            step, batch = self._queue.get()
+            item = self._queue.get()
+            if item is self._FAILED:
+                # Re-queue the sentinel: the worker is dead (nothing else
+                # will ever be enqueued), so every subsequent __next__
+                # must also raise instead of blocking forever.
+                self._queue.put(item)
+                raise RuntimeError(
+                    f"BatchLoader prefetch worker failed on split "
+                    f"{self.split!r}: {self._worker_exc!r}"
+                ) from self._worker_exc
+            step, batch = item
             self.step = step + 1
             return batch
         batch = self._load(self.step)

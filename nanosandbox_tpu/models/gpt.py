@@ -383,6 +383,24 @@ def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
             for _ in range(cfg.n_layer)]
 
 
+def scatter_cache_rows(pool: list, rows: list, slots: jax.Array) -> list:
+    """Write a prefill wave's per-layer (k, H, L, D) K/V rows into the
+    slot rows of a (num_slots, H, max_len, D) pool at columns [0, L).
+
+    The scatter uses mode='drop': a slot id >= num_slots (the serve
+    engine's ladder-padding rows) writes nowhere, unlike
+    dynamic_update_slice whose index CLAMP would silently overwrite the
+    last real slot row. Stale columns past L are hidden by the per-row
+    causal mask until the new occupant's decode overwrites them."""
+    out = []
+    for (pk, pv), (ck, cv) in zip(pool, rows):
+        L = ck.shape[2]
+        pk = pk.at[slots, :, :L, :].set(ck.astype(pk.dtype), mode="drop")
+        pv = pv.at[slots, :, :L, :].set(cv.astype(pv.dtype), mode="drop")
+        out.append((pk, pv))
+    return out
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
                        ignore_index: int = -1) -> jax.Array:
     """Mean next-token cross entropy; positions == ignore_index are masked.

@@ -129,6 +129,20 @@ def _sample_token_rows(logits_i, rng, *, temperature, top_k, top_p):
     return jnp.where(t == 0.0, greedy, sampled), rng
 
 
+def row_keys(seeds, positions):
+    """(B,) typed PRNG keys, one per row: fold_in(key(seeds[b]),
+    positions[b]). The serve engine's sampling-stream contract — the
+    token destined for position q of a request seeded s is always drawn
+    from fold_in(key(s), q), whether it comes from a prefill wave or a
+    batched decode step — lives here so the two compiled paths can never
+    drift apart."""
+    import jax
+
+    return jax.vmap(
+        lambda s, q: jax.random.fold_in(jax.random.key(s), q)
+    )(seeds, positions)
+
+
 def _is_key_batch(rng) -> bool:
     """True when rng is a (B,) batch of typed PRNG keys (vs one key)."""
     import jax

@@ -10,9 +10,11 @@ single largest throughput lever on TPU.
 
 Pieces:
   scheduler.py — SlotScheduler: FIFO queue, free-slot pool, prefill
-                 bucket ladder (the fixed-shape admission policy).
-  engine.py    — Engine: slot-based KV cache pool, bucketed prefill,
-                 batched per-row decode, submit()/step()/drain().
+                 bucket ladder + admission-wave ladder (the fixed-shape
+                 admission policy).
+  engine.py    — Engine: slot-based KV cache pool, batched wave prefill,
+                 pipelined per-row decode over device-resident slot
+                 state, submit()/step()/drain().
   http.py      — EngineLoop (background stepping thread) + a stdlib
                  ThreadingHTTPServer frontend.
   __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
@@ -20,7 +22,8 @@ Pieces:
 """
 
 from nanosandbox_tpu.serve.engine import Engine, Request, Result
-from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
+from nanosandbox_tpu.serve.scheduler import (SlotScheduler, admit_ladder,
+                                             default_buckets)
 
 __all__ = ["Engine", "Request", "Result", "SlotScheduler",
-           "default_buckets"]
+           "admit_ladder", "default_buckets"]

@@ -27,6 +27,15 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--max_len", type=int, default=0,
                     help="per-slot KV length; 0 = block_size")
     ap.add_argument("--device", default="auto")
+    ap.add_argument("--no_pipeline", action="store_true",
+                    help="synchronous decode loop (debugging baseline); "
+                         "default keeps one decode step in flight")
+    ap.add_argument("--warmup", choices=("full", "buckets"), default="full",
+                    help="'full' compiles every (wave-size, bucket) "
+                         "prefill pair before binding the port (the "
+                         "/healthz readiness contract); 'buckets' "
+                         "compiles one single-request prefill per bucket "
+                         "and leaves larger waves to compile lazily")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
     from nanosandbox_tpu.data.loader import BinDataset
@@ -45,22 +54,41 @@ def main(argv: list[str] | None = None) -> None:
     tok = get_tokenizer(ds.meta.get("kind", "char"), ds.meta)
 
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
-                    max_len=args.max_len or None)
-    # Warm every prefill bucket + the decode step BEFORE binding the
-    # port: /healthz going green is the readiness contract the k8s
-    # manifest and docs promise ("restore + first compile done"), so no
-    # live request may ever eat a cold XLA compile. The compile set is
-    # bounded by design (len(buckets) + 1), so this is a fixed, small
-    # startup cost.
+                    max_len=args.max_len or None,
+                    pipeline=not args.no_pipeline)
+    # Warm the compile set BEFORE binding the port: /healthz going green
+    # is the readiness contract the k8s manifest and docs promise
+    # ("restore + first compile done"), so no live request may ever eat
+    # a cold XLA compile. The set is bounded by design —
+    # len(admit_ladder) * len(buckets) prefills + admit/release/decode —
+    # so this is a fixed startup cost; --warmup=buckets trades lazy
+    # wave-size compiles for a faster start.
+    rungs = (engine.admit_buckets if args.warmup == "full" else [1])
+    lo = 1
     for bucket in engine.sched.buckets:
-        # max_new_tokens=2, not 1: a 1-token request finishes on its
-        # prefill-sampled token and would never touch (= compile) the
-        # batched decode step.
-        engine.submit([0] * min(bucket, engine.max_len - 2), 2)
-    engine.drain()
+        # Warmup prompt length must actually MAP to this bucket (in
+        # (previous rung, bucket]) and leave room for 2 new tokens; a
+        # bucket with no such length (max_len within 2 of the previous
+        # rung) is unreachable by any decodable request, so skipping it
+        # keeps the readiness contract honest rather than violating it.
+        length = min(bucket, engine.max_len - 2)
+        lo, prev_lo = bucket + 1, lo
+        if length < prev_lo:
+            continue
+        for k in rungs:
+            # max_new_tokens=2, not 1: a 1-token request finishes on its
+            # prefill-sampled token and would never touch (= compile)
+            # the batched decode step. k same-bucket submissions land as
+            # ONE admission wave, compiling the (k, bucket) prefill.
+            for _ in range(k):
+                engine.submit([0] * length, 2)
+            engine.drain()
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
-          f"prefill bucket(s) + {engine.trace_counts['decode']} decode "
-          "step", file=sys.stderr, flush=True)
+          f"prefill program(s) ({args.warmup}), "
+          f"{engine.trace_counts['admit']} admit, "
+          f"{engine.trace_counts['decode']} decode "
+          f"(pipeline={'off' if args.no_pipeline else 'on'})",
+          file=sys.stderr, flush=True)
     loop = EngineLoop(engine)
     loop.start()
     server = make_server(args.host, args.port, loop, tok.encode,

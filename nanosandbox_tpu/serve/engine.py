@@ -9,17 +9,33 @@ Design (the TPU fixed-shape discipline, end to end):
     prefill overwrites it and the per-row causal mask hides any stale
     tail.
 
-  * Prefill: a request admitted into a slot runs the model once over
-    its prompt padded to a bucket length (scheduler ladder), writing
-    the bucket's K/V columns into the slot row and sampling the first
-    token from the TRUE last prompt position. One compiled program per
-    bucket, ever.
+  * Batched prefill: an admission WAVE — the FIFO prefix of the queue
+    sharing one prompt bucket, up to the free slots — runs the model
+    once over a (k, L_bucket) prompt block, scatters the K/V rows into
+    the wave's slot rows, and samples each request's first token from
+    its TRUE last prompt position. k is padded up a power-of-two ladder
+    (scheduler.admit_ladder) so the compile set stays bounded at
+    len(admit_ladder) * len(buckets) programs.
 
-  * Decode: every step runs the model on (num_slots, 1) tokens with a
-    PER-ROW cache_index vector (models/gpt.py per-row frontier path) —
-    active rows each at their own position, idle rows riding along as
-    padding whose outputs are ignored. Exactly one compiled decode
-    program regardless of the request mix.
+  * Device-resident slot state: the per-slot decode operands
+    (pos/tok/temp/top_k/top_p/seed/active) live in a donated on-device
+    struct threaded through the decode step alongside the pool — the
+    decode hot loop uploads NOTHING from the host. Admission and
+    eviction mutate the struct through two small compiled programs
+    (_admit_fn / _release_fn) instead of re-staging six host arrays
+    every token.
+
+  * Pipelined decode: step k+1 is dispatched from the device-resident
+    token array of step k BEFORE step k's tokens are read back, so the
+    per-token host round trip overlaps device compute instead of
+    serializing with it (the same async-dispatch discipline
+    train.estimate_loss applies to eval). Finish/eviction decisions
+    therefore lag ONE step: a row that finished at step k still rides
+    along in step k+1, and its ride-along token is dropped at readback
+    via the dispatch-time (slot -> rid) snapshot — a backfilled slot's
+    new occupant can never inherit it. On device the active mask parks
+    finished/idle rows (pos frozen, token pinned) so their garbage
+    stays inside their own slot row.
 
   * Sampling is per-row (_sample_token with (S,) parameter vectors) and
     per-row keyed: the token at position q of request r is sampled with
@@ -27,22 +43,27 @@ Design (the TPU fixed-shape discipline, end to end):
     function of (params, prompt, settings, seed) — independent of which
     other requests happen to share its batch. That invariant is what
     makes continuous batching testable against single-request
-    sample.generate token-for-token.
+    sample.generate token-for-token, and it survives pipelining because
+    the device state the next step consumes is exactly the sampled
+    token the host would have re-uploaded.
 
-The engine is synchronous and single-threaded by design (one step() ==
-one decode dispatch + one host sync for the sampled tokens); http.py
-wraps it in a background thread for concurrent clients.
+The engine is single-threaded by design (one step() == at most one
+decode dispatch + one lagged readback); http.py wraps it in a
+background thread for concurrent clients.
 """
 
 from __future__ import annotations
 
 import itertools
+import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
+from nanosandbox_tpu.utils.metrics import RingStat
 
 
 @dataclass(frozen=True)
@@ -72,6 +93,7 @@ class _Active:
     req: Request
     slot: int
     tokens: List[int] = field(default_factory=list)
+    first_token_t: float = 0.0   # wall clock of the prefill-token readback
 
 
 class Engine:
@@ -86,12 +108,19 @@ class Engine:
         at block_size (wpe defines no positions past it).
     prefill_buckets : padded prompt lengths to compile; default is the
         power-of-two ladder up to max_len.
+    pipeline : keep one decode step in flight ahead of the host
+        (default). False restores the synchronous PR-1 loop — dispatch,
+        read back, repeat — which bench.py uses as the comparison
+        baseline; results are identical either way, only the
+        dispatch/readback overlap differs.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_len: Optional[int] = None,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 pipeline: bool = True):
         import jax
+        import jax.numpy as jnp
 
         from nanosandbox_tpu.models.gpt import init_cache
 
@@ -100,6 +129,7 @@ class Engine:
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
+        self.pipeline = bool(pipeline)
         self.max_len = min(max_len or cfg.block_size, cfg.block_size)
         buckets = (sorted(b for b in prefill_buckets if b <= self.max_len)
                    if prefill_buckets else default_buckets(self.max_len))
@@ -107,93 +137,151 @@ class Engine:
             raise ValueError("no prefill bucket fits within max_len "
                              f"{self.max_len}: {prefill_buckets!r}")
         self.sched = SlotScheduler(num_slots, buckets)
+        self.admit_buckets = self.sched.admit_buckets
 
         self._pool = init_cache(cfg, num_slots, self.max_len)
-        # Per-slot device-step operands, mirrored host-side as numpy so
-        # admission/eviction are plain array writes. Idle rows keep
-        # harmless values (pos 0, temperature 0): they decode garbage
-        # into their own slot row, which the next prefill overwrites.
-        self._pos = np.zeros(num_slots, np.int32)
-        self._tok = np.zeros(num_slots, np.int32)
-        self._temp = np.zeros(num_slots, np.float32)
-        self._topk = np.zeros(num_slots, np.int32)
-        self._topp = np.ones(num_slots, np.float32)
-        self._seed = np.zeros(num_slots, np.int32)
+        # Device-resident per-slot decode operands. Idle rows keep
+        # harmless parked values (pos 0, temperature 0, active False):
+        # their garbage decode writes stay inside their own slot row,
+        # which the next prefill overwrites.
+        self._state = {
+            "pos": jnp.zeros(num_slots, jnp.int32),
+            "tok": jnp.zeros(num_slots, jnp.int32),
+            "temp": jnp.zeros(num_slots, jnp.float32),
+            "topk": jnp.zeros(num_slots, jnp.int32),
+            "topp": jnp.ones(num_slots, jnp.float32),
+            "seed": jnp.zeros(num_slots, jnp.int32),
+            "active": jnp.zeros(num_slots, jnp.bool_),
+        }
 
         self._active: Dict[int, _Active] = {}        # slot -> state
         self._pending_results: List[Result] = []     # max_new_tokens == 0
+        # The one decode step in flight ahead of the host: (device token
+        # array, {slot: rid} snapshot at dispatch). The snapshot is the
+        # host half of the eviction lag — a slot whose occupant changed
+        # between dispatch and readback drops its ride-along token.
+        self._inflight: Optional[Tuple[object, Dict[int, int]]] = None
         self._rid = itertools.count()
+        self._submit_meta: Dict[int, Tuple[int, float]] = {}  # rid -> (step, t)
         self.steps = 0
         self.admitted = 0
         self.completed = 0
+        self.tokens_generated = 0
+        # Latency/throughput observability (bounded rings — /stats must
+        # stay O(1) memory no matter how long the server runs).
+        self._ttft = RingStat(1024)          # submit -> first-token seconds
+        self._tpot = RingStat(1024)          # per-token seconds after first
+        self._queue_wait = RingStat(1024)    # decode steps spent queued
+        self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
         # Trace-time side-effect counters: each retrace of a step
         # function bumps these, so a shape leak (e.g. a Python scalar
         # specializing a trace) shows up as a failing compile-budget
         # assert instead of a silent 10x serving slowdown.
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        self.trace_counts = {"prefill": 0, "decode": 0,
+                             "admit": 0, "release": 0}
 
-        # CPU jit ignores donation (and warns); only donate the pool on
-        # accelerators, where reusing the KV buffers in place matters.
-        donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
-        self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+        # CPU jit ignores donation (and warns); only donate pool/state on
+        # accelerators, where reusing the buffers in place matters.
+        on_accel = jax.default_backend() != "cpu"
+        self._prefill = jax.jit(
+            self._prefill_fn, donate_argnums=(1,) if on_accel else ())
+        self._decode = jax.jit(
+            self._decode_fn, donate_argnums=(1, 2) if on_accel else ())
+        self._admit = jax.jit(
+            self._admit_fn, donate_argnums=(0,) if on_accel else ())
+        self._release = jax.jit(
+            self._release_fn, donate_argnums=(0,) if on_accel else ())
 
     # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
-    def _prefill_fn(self, params, pool, prompt, true_len, slot,
-                    temp, top_k, top_p, seed):
-        """Prompt (1, L_bucket) -> (new pool, first sampled token (1,)).
+    def _prefill_fn(self, params, pool, prompts, true_lens, slots,
+                    temps, top_ks, top_ps, seeds):
+        """Admission wave (k, L_bucket) -> (new pool, first tokens (k,)).
 
-        Runs the ordinary scalar-cache prefill on a batch-1 temp cache of
-        the bucket length, then writes those columns into the slot's pool
-        row. Positions >= true_len hold garbage K/V — decode overwrites
-        each position before attending to it and the per-row mask hides
-        the rest, so padding never leaks into any output (the greedy
-        parity test pins this)."""
-        import jax
+        Runs the ordinary scalar-cache prefill on a batch-k temp cache of
+        the bucket length, then scatters those rows into the wave's slot
+        rows. Positions >= true_lens[i] hold garbage K/V — decode
+        overwrites each position before attending to it and the per-row
+        mask hides the rest, so padding never leaks into any output (the
+        greedy parity test pins this). Ladder-padding rows carry slot id
+        num_slots, which the scatter drops on the floor."""
         import jax.numpy as jnp
-        from jax import lax
 
-        from nanosandbox_tpu.models.gpt import init_cache
-        from nanosandbox_tpu.sample import _sample_token
+        from nanosandbox_tpu.models.gpt import init_cache, scatter_cache_rows
+        from nanosandbox_tpu.sample import _sample_token, row_keys
 
         self.trace_counts["prefill"] += 1
-        L = prompt.shape[1]
-        cache = init_cache(self.cfg, 1, L)
-        logits, cache = self.model.apply({"params": params}, prompt,
+        k, L = prompts.shape
+        cache = init_cache(self.cfg, k, L)
+        logits, cache = self.model.apply({"params": params}, prompts,
                                          deterministic=True, cache=cache,
                                          cache_index=0)
-        new_pool = []
-        for (pk, pv), (ck, cv) in zip(pool, cache):
-            pk = lax.dynamic_update_slice(pk, ck, (slot, 0, 0, 0))
-            pv = lax.dynamic_update_slice(pv, cv, (slot, 0, 0, 0))
-            new_pool.append((pk, pv))
-        last = logits[0, true_len - 1, :]
+        new_pool = scatter_cache_rows(pool, cache, slots)
+        last = logits[jnp.arange(k), true_lens - 1, :]
         # Token destined for position true_len: fold_in(seed, true_len) —
         # the same stream the decode step continues at true_len + 1.
-        key = jax.random.fold_in(jax.random.key(seed), true_len)
-        tok, _ = _sample_token(last[None, :], key[None],
-                               temperature=temp, top_k=top_k, top_p=top_p)
-        return new_pool, tok[0]
+        keys = row_keys(seeds, true_lens)
+        toks, _ = _sample_token(last, keys, temperature=temps,
+                                top_k=top_ks, top_p=top_ps)
+        return new_pool, toks
 
-    def _decode_fn(self, params, pool, tokens, pos, temps, top_ks, top_ps,
-                   seeds):
-        """One batched token step over ALL slots at per-row frontiers."""
-        import jax
+    def _decode_fn(self, params, pool, state):
+        """One batched token step over ALL slots at per-row frontiers.
 
-        from nanosandbox_tpu.sample import _sample_token
+        Returns (pool, state, tokens): pos advances and the sampled token
+        becomes the next step's input ON DEVICE, so the host can dispatch
+        step k+1 without ever reading step k back. Inactive rows are
+        parked by the mask — frozen pos, pinned token — so a released
+        slot's garbage can't random-walk its own state."""
+        import jax.numpy as jnp
+
+        from nanosandbox_tpu.sample import _sample_token, row_keys
 
         self.trace_counts["decode"] += 1
-        logits, pool = self.model.apply({"params": params}, tokens[:, None],
+        logits, pool = self.model.apply({"params": params},
+                                        state["tok"][:, None],
                                         deterministic=True, cache=pool,
-                                        cache_index=pos)
-        keys = jax.vmap(
-            lambda s, q: jax.random.fold_in(jax.random.key(s), q)
-        )(seeds, pos + 1)
-        nxt, _ = _sample_token(logits[:, 0, :], keys, temperature=temps,
-                               top_k=top_ks, top_p=top_ps)
-        return pool, nxt
+                                        cache_index=state["pos"])
+        keys = row_keys(state["seed"], state["pos"] + 1)
+        nxt, _ = _sample_token(logits[:, 0, :], keys,
+                               temperature=state["temp"],
+                               top_k=state["topk"], top_p=state["topp"])
+        active = state["active"]
+        new_state = dict(state,
+                         pos=state["pos"] + active.astype(jnp.int32),
+                         tok=jnp.where(active, nxt, state["tok"]))
+        return pool, new_state, nxt
+
+    def _admit_fn(self, state, slots, pos0, toks, temps, top_ks, top_ps,
+                  seeds):
+        """Scatter an admission wave's operands into the slot-state rows.
+
+        One (k,)-shaped program per admit-ladder rung; padding rows carry
+        the out-of-range slot id num_slots, dropped by the scatter."""
+        self.trace_counts["admit"] += 1
+        return {
+            "pos": state["pos"].at[slots].set(pos0, mode="drop"),
+            "tok": state["tok"].at[slots].set(toks, mode="drop"),
+            "temp": state["temp"].at[slots].set(temps, mode="drop"),
+            "topk": state["topk"].at[slots].set(top_ks, mode="drop"),
+            "topp": state["topp"].at[slots].set(top_ps, mode="drop"),
+            "seed": state["seed"].at[slots].set(seeds, mode="drop"),
+            "active": state["active"].at[slots].set(True, mode="drop"),
+        }
+
+    def _release_fn(self, state, slot):
+        """Park one slot row back at the harmless idle values."""
+        self.trace_counts["release"] += 1
+        return {
+            "pos": state["pos"].at[slot].set(0),
+            "tok": state["tok"].at[slot].set(0),
+            "temp": state["temp"].at[slot].set(0.0),
+            "topk": state["topk"].at[slot].set(0),
+            "topp": state["topp"].at[slot].set(1.0),
+            "seed": state["seed"].at[slot].set(0),
+            "active": state["active"].at[slot].set(False),
+        }
 
     # ------------------------------------------------------------------
     # public API
@@ -230,67 +318,57 @@ class Engine:
                 Result(rid=rid, prompt=prompt, tokens=[],
                        finish_reason="length"))
             return rid
+        self._submit_meta[rid] = (self.steps, time.monotonic())
         self.sched.enqueue(req)
         return rid
 
     def has_work(self) -> bool:
         return bool(self._active or self.sched.queued
-                    or self._pending_results)
+                    or self._pending_results or self._inflight is not None)
 
     def step(self) -> List[Result]:
-        """Admit as many queued requests as slots allow (prefill +
-        first token), then run one batched decode step over every slot.
-        Returns the requests that finished during this step."""
-        import jax.numpy as jnp
-
+        """Admit as many queued requests as slots allow (one batched
+        prefill per wave), dispatch one batched decode step, then retire
+        the PREVIOUS step's readback (pipelined; with pipeline=False the
+        readback is the step just dispatched). Returns the requests that
+        finished during this call."""
         finished, self._pending_results = self._pending_results, []
 
-        # Backfill free slots mid-flight; a request finishing on its
-        # prefill token immediately frees its slot for the next in line.
-        while (adm := self.sched.next_admission()) is not None:
-            req, slot, bucket = adm
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(req.prompt)] = req.prompt
-            self._pool, tok0 = self._prefill(
-                self.params, self._pool, jnp.asarray(padded),
-                jnp.asarray(len(req.prompt), jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32),
-                jnp.asarray(req.seed, jnp.int32))
-            self.admitted += 1
-            state = _Active(req=req, slot=slot, tokens=[int(tok0)])
-            self._pos[slot] = len(req.prompt)
-            self._tok[slot] = state.tokens[-1]
-            self._temp[slot] = req.temperature
-            self._topk[slot] = req.top_k
-            self._topp[slot] = req.top_p
-            self._seed[slot] = req.seed
-            self._active[slot] = state
-            done = self._maybe_finish(state)
-            if done is not None:
-                finished.append(done)
+        # Backfill free slots mid-flight; a wave finishing on its prefill
+        # tokens immediately frees slots for the next wave in line.
+        self._admit_waves(finished)
 
-        if self._active:
-            self._pool, nxt = self._decode(
-                self.params, self._pool,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp), jnp.asarray(self._seed))
+        retired = False
+        if self._active and self._needs_decode():
+            self._pool, self._state, toks = self._decode(
+                self.params, self._pool, self._state)
             self.steps += 1
-            nxt = np.asarray(nxt)
-            for slot, state in list(self._active.items()):
-                state.tokens.append(int(nxt[slot]))
-                self._pos[slot] += 1
-                self._tok[slot] = int(nxt[slot])
-                done = self._maybe_finish(state)
-                if done is not None:
-                    finished.append(done)
+            snapshot = {slot: st.req.rid
+                        for slot, st in self._active.items()}
+            prev, self._inflight = self._inflight, (toks, snapshot)
+            if not self.pipeline:
+                inflight, self._inflight = self._inflight, None
+                self._retire(inflight, finished)
+                retired = True
+            elif prev is not None:
+                self._retire(prev, finished)
+                retired = True
+        elif self._inflight is not None:
+            # Nothing left to dispatch (all rows' budgets covered by
+            # computed tokens) — drain the lagging readback.
+            inflight, self._inflight = self._inflight, None
+            self._retire(inflight, finished)
+            retired = True
+        if retired:
+            # Slots the retire just freed backfill NOW — their prefill
+            # queues behind the in-flight step and the next dispatch
+            # picks the new rows up, so eviction->readmission costs the
+            # same one-step lag as the synchronous loop instead of two.
+            self._admit_waves(finished)
         return finished
 
     def drain(self) -> List[Result]:
-        """Run step() until queue and slots are empty; all results."""
+        """Run step() until queue, slots and pipeline are empty."""
         out: List[Result] = []
         while self.has_work():
             out.extend(self.step())
@@ -301,17 +379,153 @@ class Engine:
             "num_slots": self.num_slots,
             "max_len": self.max_len,
             "prefill_buckets": list(self.sched.buckets),
+            "admit_buckets": list(self.admit_buckets),
+            "pipeline": self.pipeline,
             "active": len(self._active),
             "queued": self.sched.queued,
             "free_slots": self.sched.free_slots,
             "admitted": self.admitted,
             "completed": self.completed,
             "decode_steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "decode_tokens_per_sec": self._recent_rate(),
+            "queue_wait_steps_mean": self._queue_wait.mean(),
+            "ttft_s": self._ttft.percentiles((50, 90, 99)),
+            "tpot_s": self._tpot.percentiles((50, 90, 99)),
             "trace_counts": dict(self.trace_counts),
         }
 
+    def max_programs(self) -> dict:
+        """The closed compile set by program kind — the compile-budget
+        contract the trace-counter asserts (tests, CI) check against."""
+        return {
+            "prefill": len(self.sched.buckets) * len(self.admit_buckets),
+            "decode": 1,
+            "admit": len(self.admit_buckets),
+            "release": 1,
+        }
+
     # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _admit_waves(self, finished: List[Result]) -> None:
+        import jax.numpy as jnp
+
+        while (wave := self.sched.next_admission_wave()) is not None:
+            reqs, slots, bucket = wave
+            k = self.sched.rung_for(len(reqs))
+            # Host staging for the wave — the ONLY host->device uploads
+            # the engine performs; the per-token loop stages nothing.
+            prompts = np.zeros((k, bucket), np.int32)
+            true_lens = np.ones(k, np.int32)
+            # Padding rows point at slot id num_slots: out of range, so
+            # both the pool scatter and the state scatter drop them.
+            slots_arr = np.full(k, self.num_slots, np.int32)
+            temps = np.zeros(k, np.float32)
+            top_ks = np.zeros(k, np.int32)
+            top_ps = np.ones(k, np.float32)
+            seeds = np.zeros(k, np.int32)
+            for i, (req, slot) in enumerate(zip(reqs, slots)):
+                prompts[i, :len(req.prompt)] = req.prompt
+                true_lens[i] = len(req.prompt)
+                slots_arr[i] = slot
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+                seeds[i] = req.seed
+            true_lens = jnp.asarray(true_lens)
+            slots_dev = jnp.asarray(slots_arr)
+            temps = jnp.asarray(temps)
+            top_ks = jnp.asarray(top_ks)
+            top_ps = jnp.asarray(top_ps)
+            seeds = jnp.asarray(seeds)
+            self._pool, toks = self._prefill(
+                self.params, self._pool, jnp.asarray(prompts), true_lens,
+                slots_dev, temps, top_ks, top_ps, seeds)
+            # First tokens flow device-to-device into the slot state; the
+            # host copy below is for result lists and finish checks only.
+            self._state = self._admit(self._state, slots_dev, true_lens,
+                                      toks, temps, top_ks, top_ps, seeds)
+            toks_host = np.asarray(toks)
+            now = time.monotonic()
+            self._rate_ring.append((now, len(reqs)))
+            for i, (req, slot) in enumerate(zip(reqs, slots)):
+                self.admitted += 1
+                self.tokens_generated += 1
+                sub_step, sub_t = self._submit_meta.pop(req.rid)
+                self._queue_wait.record(self.steps - sub_step)
+                self._ttft.record(now - sub_t)
+                st = _Active(req=req, slot=slot,
+                             tokens=[int(toks_host[i])], first_token_t=now)
+                self._active[slot] = st
+                done = self._maybe_finish(st)
+                if done is not None:
+                    finished.append(done)
+
+    def _needs_decode(self) -> bool:
+        """False only when every active row's token budget is already
+        covered by computed tokens (read back + the one in flight) — a
+        dispatch then could only produce ride-along garbage. eos can
+        finish a row EARLIER than its budget, never later, so this
+        length-only test never skips a needed step."""
+        inflight_slots = (self._inflight[1]
+                          if self._inflight is not None else {})
+        for slot, st in self._active.items():
+            have = len(st.tokens) + (1 if inflight_slots.get(slot)
+                                     == st.req.rid else 0)
+            if have < st.req.max_new_tokens:
+                return True
+        return False
+
+    def _retire(self, inflight: Tuple[object, Dict[int, int]],
+                finished: List[Result]) -> None:
+        """Read one dispatched step's tokens back and apply the lagged
+        finish/eviction decisions. A slot whose occupant is no longer the
+        snapshot's rid was evicted after dispatch — its ride-along token
+        belongs to nobody and is dropped (the host half of the one-step
+        finish lag; the device active mask is the other half)."""
+        toks, snapshot = inflight
+        nxt = np.asarray(toks)
+        now = time.monotonic()
+        n_live = 0
+        for slot, rid in snapshot.items():
+            st = self._active.get(slot)
+            if st is None or st.req.rid != rid:
+                continue
+            st.tokens.append(int(nxt[slot]))
+            n_live += 1
+            done = self._maybe_finish(st)
+            if done is not None:
+                finished.append(done)
+        self.tokens_generated += n_live
+        self._rate_ring.append((now, n_live))
+
+    def _recent_rate(self) -> Optional[float]:
+        # list(deque): single C-level copy — stats() may run on an HTTP
+        # handler thread while the engine loop appends, and Python-level
+        # deque iteration would raise "mutated during iteration".
+        ring = list(self._rate_ring)
+        if len(ring) < 2:
+            return None
+        t0, t1 = ring[0][0], ring[-1][0]
+        if t1 <= t0:
+            return None
+        # Tokens attributed to the window AFTER its first timestamp.
+        toks = sum(n for _, n in ring[1:])
+        return toks / (t1 - t0)
+
+    def reset_latency_stats(self) -> None:
+        """Clear the TTFT/TPOT/queue-wait/rate rings — benchmarks call
+        this between warmup and the timed workload so the reported
+        percentiles describe the measured traffic, not compile-time."""
+        self._ttft.clear()
+        self._tpot.clear()
+        self._queue_wait.clear()
+        self._rate_ring.clear()
+
     def _maybe_finish(self, state: _Active) -> Optional[Result]:
+        import jax.numpy as jnp
+
         req = state.req
         reason = None
         if req.eos_id is not None and state.tokens[-1] == req.eos_id:
@@ -322,14 +536,14 @@ class Engine:
             return None
         del self._active[state.slot]
         self.sched.release(state.slot)
-        # Park the idle row at a harmless frontier; its garbage decode
-        # writes stay inside its own slot row.
-        self._pos[state.slot] = 0
-        self._tok[state.slot] = 0
-        self._temp[state.slot] = 0.0
-        self._topk[state.slot] = 0
-        self._topp[state.slot] = 1.0
-        self._seed[state.slot] = 0
+        # Park the idle row on device; queued after any in-flight step,
+        # so the ride-along step (if one is in flight) still reads the
+        # pre-release state it was dispatched with.
+        self._state = self._release(self._state,
+                                    jnp.asarray(state.slot, jnp.int32))
         self.completed += 1
+        if len(state.tokens) > 1:
+            self._tpot.record((time.monotonic() - state.first_token_t)
+                              / (len(state.tokens) - 1))
         return Result(rid=req.rid, prompt=req.prompt, tokens=state.tokens,
                       finish_reason=reason)

@@ -72,6 +72,17 @@ class EngineLoop(threading.Thread):
             self._stopping = True
             self._cond.notify()
 
+    def stats(self) -> dict:
+        """Loop-side in-flight accounting for /stats: requests parked in
+        the inbox (not yet submitted to the engine) and requests whose
+        waiters are still blocked. With the pipelined engine a result can
+        retire a step after its last decode dispatch, so `waiting` may
+        exceed the engine's `active` count by the readback lag."""
+        with self._cond:
+            return {"inbox": len(self._inbox),
+                    "waiting": len(self._by_rid),
+                    "dead": self.dead}
+
     def run(self) -> None:
         while True:
             with self._cond:
@@ -131,7 +142,10 @@ def make_server(host: str, port: int, loop: EngineLoop,
                      eos_id}  ->  {"id", "tokens", "text",
                      "finish_reason"}
     GET  /healthz   -> {"ok": true}
-    GET  /stats     -> engine counters (slots, queue, compiles, ...)
+    GET  /stats     -> engine counters (slots, queue, compiles) plus the
+                     latency signal (decode_tokens_per_sec,
+                     queue_wait_steps_mean, ttft_s/tpot_s percentiles)
+                     and loop in-flight accounting under "loop"
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -154,7 +168,9 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 else:
                     self._json(200, {"ok": True})
             elif self.path == "/stats":
-                self._json(200, loop.engine.stats())
+                stats = loop.engine.stats()
+                stats["loop"] = loop.stats()
+                self._json(200, stats)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -179,8 +195,12 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 )
                 if payload.get("eos_id") is not None:
                     kwargs["eos_id"] = int(payload["eos_id"])
-            except (ValueError, TypeError, json.JSONDecodeError) as e:
-                self._json(400, {"error": f"bad request: {e}"})
+            except (ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as e:
+                # KeyError: a char tokenizer raises it for prompt chars
+                # outside the training vocab — a client error (400), not
+                # a handler crash that closes the socket with no reply.
+                self._json(400, {"error": f"bad request: {e!r}"})
                 return
             try:
                 res = loop.generate(timeout=request_timeout, **kwargs)

@@ -21,6 +21,25 @@ from collections import deque
 from typing import Deque, List, Optional, Tuple
 
 
+def admit_ladder(num_slots: int) -> List[int]:
+    """Power-of-two admission-wave sizes: 1, 2, 4, ..., num_slots.
+
+    A batched prefill runs one (k, L_bucket) program per wave; padding the
+    wave size k up this ladder bounds the prefill compile set at
+    len(admit_ladder) * len(buckets) instead of num_slots * len(buckets).
+    num_slots itself is always the last rung so a full-batch wave never
+    pads past capacity."""
+    if num_slots < 1:
+        raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+    ladder: List[int] = []
+    k = 1
+    while k < num_slots:
+        ladder.append(k)
+        k *= 2
+    ladder.append(num_slots)
+    return ladder
+
+
 def default_buckets(max_len: int, min_bucket: int = 16) -> List[int]:
     """Power-of-two prefill ladder capped at max_len: 16, 32, ... max_len.
 
@@ -57,6 +76,7 @@ class SlotScheduler:
                              f"got {buckets!r}")
         self.num_slots = num_slots
         self.buckets = list(buckets)
+        self.admit_buckets = admit_ladder(num_slots)
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         self._queue: Deque = deque()
 
@@ -73,7 +93,7 @@ class SlotScheduler:
         return len(self._free)
 
     def bucket_for(self, prompt_len: int) -> int:
-        """Smallest ladder rung >= prompt_len."""
+        """Smallest prefill-length rung >= prompt_len."""
         for b in self.buckets:
             if prompt_len <= b:
                 return b
@@ -81,14 +101,50 @@ class SlotScheduler:
             f"prompt length {prompt_len} exceeds the largest prefill "
             f"bucket {self.buckets[-1]}")
 
+    def rung_for(self, wave_size: int) -> int:
+        """Smallest admission-wave rung >= wave_size — bucket_for's twin
+        on the other ladder, kept here so BOTH fixed-shape admission
+        policies live in one file."""
+        for k in self.admit_buckets:
+            if wave_size <= k:
+                return k
+        raise ValueError(
+            f"wave size {wave_size} exceeds num_slots {self.num_slots}")
+
     def next_admission(self) -> Optional[Tuple[object, int, int]]:
         """(queued item, slot, prefill bucket) when both a queued request
-        and a free slot exist, else None. Pops both."""
+        and a free slot exist, else None. Pops both. A wave of one — the
+        single-admission convenience view over next_admission_wave, so
+        there is exactly ONE admission code path to keep correct."""
+        wave = self.next_admission_wave(max_items=1)
+        if wave is None:
+            return None
+        (item,), (slot,), bucket = wave
+        return item, slot, bucket
+
+    def next_admission_wave(self, max_items: Optional[int] = None,
+                            ) -> Optional[Tuple[List, List[int], int]]:
+        """(items, slots, bucket): the maximal FIFO *prefix* of the queue
+        whose prompts share the head's prefill bucket, capped at the free
+        slots (and optionally at ``max_items``). One batched
+        (len(items), bucket) prefill admits the whole wave.
+
+        Strictly a prefix — a queued request with a different bucket ends
+        the wave rather than being jumped over, so admission order stays
+        FIFO (the starvation-free guarantee above) even though same-bucket
+        runs now land together."""
         if not self._queue or not self._free:
             return None
-        item = self._queue.popleft()
-        slot = self._free.pop()
-        return item, slot, self.bucket_for(len(item.prompt))
+        bucket = self.bucket_for(len(self._queue[0].prompt))
+        items: List = []
+        slots: List[int] = []
+        while (self._queue and self._free
+               and (max_items is None or len(items) < max_items)):
+            if self.bucket_for(len(self._queue[0].prompt)) != bucket:
+                break
+            items.append(self._queue.popleft())
+            slots.append(self._free.pop())
+        return items, slots, bucket
 
     def release(self, slot: int) -> None:
         if slot in self._free:

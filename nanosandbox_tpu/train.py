@@ -527,20 +527,26 @@ class Trainer:
         _, eval_step = self.compiled_steps()
         out = {}
         for split in ("train", "val"):
-            # Enqueue every eval step, then read ONE scalar: under async
-            # dispatch each float() is a host<->device round trip (~100ms+
-            # on a tunneled PJRT transport), so a per-step readback would
-            # cost eval_iters RTTs per split — the char-convergence run
-            # spent ~40% of its wall clock there before this change.
-            losses = []
-            for i in range(eval_iters):
-                xb, yb = self.dataset.sample_batch(
+            # Build ALL host batches up front, THEN enqueue every eval
+            # step, THEN read ONE scalar. The host-side gather (memmap
+            # window copies, ~ms each) used to sit inside the enqueue
+            # loop, serializing with eval dispatch; hoisted, the device
+            # chews through back-to-back steps while the host is already
+            # done gathering. And under async dispatch each float() is a
+            # host<->device round trip (~100ms+ on a tunneled PJRT
+            # transport), so a per-step readback would cost eval_iters
+            # RTTs per split — the char-convergence run spent ~40% of its
+            # wall clock there before the single-readback change.
+            batches = [
+                self.dataset.sample_batch(
                     split, 1_000_000 + i,
                     self.cfg.batch_size // self.process_count,
                     self.cfg.block_size, seed=self.cfg.seed + 1,
                     process_index=self.process_index)
-                losses.append(eval_step(state, self.to_global(xb),
-                                        self.to_global(yb)))
+                for i in range(eval_iters)
+            ]
+            losses = [eval_step(state, self.to_global(xb), self.to_global(yb))
+                      for xb, yb in batches]
             out[split] = float(jnp.stack(losses).mean())
         return out
 

@@ -35,6 +35,53 @@ def warn_once(key: str, msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+class RingStat:
+    """Bounded ring of float samples with mean/percentile reads.
+
+    The serving latency signal (TTFT/TPOT/queue-wait in Engine.stats)
+    must cost O(1) memory over an unbounded request stream, so samples
+    live in a fixed-size deque: percentiles describe the RECENT window,
+    which is the operationally useful view (a k8s dashboard wants "how
+    slow is it now", not a lifetime average diluted by warmup)."""
+
+    def __init__(self, maxlen: int = 1024):
+        from collections import deque
+
+        self._buf = deque(maxlen=maxlen)
+
+    def record(self, x: float) -> None:
+        self._buf.append(float(x))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    def mean(self) -> float | None:
+        # list(deque) is a single C-level copy (atomic under the GIL):
+        # reads may race a recording thread (the HTTP /stats handler vs
+        # the engine loop), and Python-level iteration over a deque
+        # being appended to raises "deque mutated during iteration".
+        buf = list(self._buf)
+        if not buf:
+            return None
+        return sum(buf) / len(buf)
+
+    def percentiles(self, ps: tuple = (50, 90, 99)) -> dict | None:
+        """{"p50": ..., "p90": ..., ...} over the window (nearest-rank),
+        or None before the first sample."""
+        srt = sorted(self._buf)   # C-level snapshot+sort, like mean()
+        if not srt:
+            return None
+        n = len(srt)
+        out = {}
+        for p in ps:
+            rank = max(1, -(-int(p) * n // 100))  # ceil(p/100 * n), >= 1
+            out[f"p{int(p)}"] = srt[min(rank, n) - 1]
+        return out
+
+
 class MetricsWriter:
     def __init__(self, log_dir: str, run_name: str = "", enabled: bool = True,
                  tensorboard: bool = True):

@@ -59,6 +59,48 @@ def test_batch_loader_divisibility(char_dataset):
                     num_processes=2, prefetch=False)
 
 
+def test_batch_loader_prefetch_worker_error_propagates(char_dataset):
+    """An exception in the prefetch worker (e.g. a truncated .bin
+    mid-run) used to kill the thread silently and leave __next__ blocked
+    forever on an empty queue; it must surface in the consumer — on the
+    first __next__ after the failure AND on every later one."""
+    ds = BinDataset(char_dataset, "shakespeare_char")
+
+    class Boom(BatchLoader):
+        def _load(self, step):
+            raise OSError("truncated .bin")
+
+    loader = Boom(ds, "train", batch_size=4, block_size=16)
+    try:
+        with pytest.raises(RuntimeError, match="prefetch worker"):
+            next(loader)
+        with pytest.raises(RuntimeError, match="truncated"):
+            next(loader)   # repeat call re-raises, never deadlocks
+    finally:
+        loader.close()
+
+
+def test_batch_loader_prefetch_error_after_good_batches(char_dataset):
+    """Batches staged before the failure are still delivered in order;
+    the error surfaces exactly where the stream breaks."""
+    ds = BinDataset(char_dataset, "shakespeare_char")
+
+    class Boom(BatchLoader):
+        def _load(self, step):
+            if step >= 1:
+                raise ValueError(f"bad step {step}")
+            return super()._load(step)
+
+    loader = Boom(ds, "train", batch_size=4, block_size=16)
+    try:
+        x, y = next(loader)       # step 0 staged fine
+        assert x.shape == (4, 16)
+        with pytest.raises(RuntimeError, match="bad step 1"):
+            next(loader)
+    finally:
+        loader.close()
+
+
 def test_native_gather_matches_numpy(tmp_path):
     data = np.arange(1000, dtype=np.uint16)
     offsets = np.asarray([0, 10, 500, 991], dtype=np.int64)

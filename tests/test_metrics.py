@@ -71,3 +71,18 @@ def test_warn_once_dedupes_by_key(capsys):
     assert err.count("message A") == 1
     assert "again" not in err
     assert "message B" in err
+
+
+def test_ring_stat_percentiles_and_bound():
+    from nanosandbox_tpu.utils.metrics import RingStat
+
+    r = RingStat(maxlen=4)
+    assert r.mean() is None and r.percentiles() is None
+    for x in (1.0, 2.0, 3.0, 4.0):
+        r.record(x)
+    assert r.mean() == 2.5
+    assert r.percentiles((50, 90, 99)) == {"p50": 2.0, "p90": 4.0, "p99": 4.0}
+    r.record(10.0)           # evicts the 1.0 — bounded window
+    assert len(r) == 4
+    assert r.percentiles((99,)) == {"p99": 10.0}
+    assert r.mean() == (2 + 3 + 4 + 10) / 4

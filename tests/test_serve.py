@@ -1,11 +1,19 @@
 """Serve-engine tests: continuous batching, slot KV pool, fixed shapes.
 
-The contract under test (ISSUE 1 acceptance bar):
+The contract under test (ISSUE 1 acceptance bar, extended by ISSUE 2's
+pipelined hot loop):
   * >= 8 concurrent mixed-length requests on CPU, each token-for-token
-    identical to single-request sample.generate under greedy decoding;
-  * a bounded compile set — at most one program per prefill bucket plus
-    ONE decode shape, asserted via the engine's trace counters;
-  * mid-flight backfill: more requests than slots all complete;
+    identical to single-request sample.generate under greedy decoding —
+    under the PIPELINED engine (one decode step in flight, finish
+    decisions lagging one step);
+  * a bounded compile set — prefill programs capped by the
+    (admit-ladder x bucket) grid, ONE decode shape, admit programs
+    capped by the ladder, ONE release shape — asserted via the engine's
+    trace counters;
+  * mid-flight backfill: more requests than slots all complete, and a
+    just-finished row's ride-along token never leaks into results or a
+    backfilled occupant;
+  * batched-prefill admission preserves FIFO order;
   * per-request determinism independent of batch composition (per-row
     keyed sampling).
 """
@@ -20,7 +28,18 @@ import pytest
 from nanosandbox_tpu.config import GPTConfig
 from nanosandbox_tpu.models.gpt import GPT
 from nanosandbox_tpu.sample import generate
-from nanosandbox_tpu.serve import Engine, SlotScheduler, default_buckets
+from nanosandbox_tpu.serve import (Engine, SlotScheduler, admit_ladder,
+                                   default_buckets)
+
+
+def _assert_compile_budget(eng):
+    """The closed-compile-set contract: every trace counter within the
+    engine's published per-kind budget (admit/release included)."""
+    budget = eng.max_programs()
+    for kind, count in eng.trace_counts.items():
+        assert count <= budget[kind], (kind, count, budget)
+    assert eng.trace_counts["decode"] <= 1
+    assert eng.trace_counts["release"] <= 1
 
 
 @pytest.fixture(scope="module")
@@ -49,6 +68,44 @@ def test_default_buckets_ladder():
     assert default_buckets(8) == [8]
     with pytest.raises(ValueError, match="max_len"):
         default_buckets(0)
+
+
+def test_admit_ladder():
+    assert admit_ladder(8) == [1, 2, 4, 8]
+    assert admit_ladder(3) == [1, 2, 3]
+    assert admit_ladder(1) == [1]
+    with pytest.raises(ValueError, match="num_slots"):
+        admit_ladder(0)
+
+
+def test_scheduler_wave_fifo_prefix():
+    """next_admission_wave pops the maximal FIFO *prefix* sharing the
+    head's bucket — a different-bucket request ends the wave instead of
+    being jumped over (FIFO preserved), and waves cap at free slots."""
+    class Item:
+        def __init__(self, n):
+            self.prompt = [0] * n
+
+    s = SlotScheduler(5, [8, 16])
+    for n in (5, 3, 9, 4, 2):   # buckets: 8, 8, 16, 8, 8
+        s.enqueue(Item(n))
+    items, slots, bucket = s.next_admission_wave()
+    # Only the two leading bucket-8 prompts: Item(9) fences the wave even
+    # though Item(4)/Item(2) behind it would fit.
+    assert bucket == 8 and [len(i.prompt) for i in items] == [5, 3]
+    assert len(slots) == len(set(slots)) == 2
+    items, slots, bucket = s.next_admission_wave()
+    assert bucket == 16 and [len(i.prompt) for i in items] == [9]
+    items, slots, bucket = s.next_admission_wave()
+    assert bucket == 8 and [len(i.prompt) for i in items] == [4, 2]
+    assert s.next_admission_wave() is None  # queue empty
+    # Free-slot cap: 4 same-bucket requests, 1 free slot -> wave of 1.
+    s2 = SlotScheduler(1, [8])
+    for _ in range(4):
+        s2.enqueue(Item(3))
+    items, slots, _ = s2.next_admission_wave()
+    assert len(items) == 1 and s2.queued == 3
+    assert s2.next_admission_wave() is None  # no free slot left
 
 
 def test_scheduler_admission_and_release():
@@ -117,10 +174,8 @@ def test_eight_concurrent_mixed_lengths_parity_and_compile_budget(
         assert res[rid].tokens == _ref_greedy(model, params, prompt, mnt,
                                               cfg.block_size), rid
 
-    n_buckets = len(eng.sched.buckets)
     assert eng.trace_counts["decode"] == 1
-    assert eng.trace_counts["prefill"] <= n_buckets
-    assert sum(eng.trace_counts.values()) <= n_buckets + 1
+    _assert_compile_budget(eng)
 
 
 def test_backfill_more_requests_than_slots(served_model):
@@ -156,6 +211,100 @@ def test_eos_evicts_early(served_model):
     assert res[rid].tokens == [first]
     assert res[rid].finish_reason == "eos"
     assert eng.stats()["free_slots"] == 1
+
+
+def test_eos_mid_stream_one_step_lag_no_ride_along_leak(served_model):
+    """The pipelined finish lag: an eos hit at step k is discovered after
+    step k+1 was dispatched, so the engine decodes one ride-along token —
+    which must NOT appear in the result, and the backfilled next occupant
+    of the slot must not inherit it either."""
+    cfg, model, params = served_model
+    # Find a prompt whose greedy stream produces a NOVEL token somewhere
+    # mid-generation (first occurrence at index >= 2) — that token is a
+    # valid mid-stream eos for this randomly-initialized model.
+    prompt = ref = idx = None
+    for cand in ([5, 3], [6, 6, 2], [42, 13, 27, 33], [49, 48, 47]):
+        r = _ref_greedy(model, params, cand, 12, cfg.block_size)
+        novel = [i for i in range(2, len(r) - 1) if r[i] not in r[:i]]
+        if novel:
+            prompt, ref, idx = cand, r, novel[0]
+            break
+    assert prompt is not None, "no candidate prompt with a mid-stream " \
+        "novel greedy token; extend the candidate list"
+    eos = ref[idx]
+    eng = Engine(model, params, num_slots=1, max_len=64)
+    rid_a = eng.submit(prompt, 12, eos_id=eos)
+    rid_b = eng.submit([9, 9], 6)   # backfills the SAME slot afterwards
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid_a].tokens == ref[:idx + 1]  # truncated AT the eos hit
+    assert res[rid_a].finish_reason == "eos"
+    assert res[rid_b].tokens == _ref_greedy(model, params, [9, 9], 6,
+                                            cfg.block_size)
+    assert eng.stats()["free_slots"] == 1
+
+
+def test_pipelined_matches_synchronous_engine(served_model):
+    """pipeline=True and pipeline=False produce identical results for an
+    identical mixed workload — the overlap is a scheduling change, not a
+    semantics change."""
+    cfg, model, params = served_model
+    rng = np.random.default_rng(3)
+    work = []
+    for i in range(7):
+        L = int(rng.integers(1, 25))
+        work.append(([int(x) for x in rng.integers(0, cfg.vocab_size, L)],
+                     int(rng.integers(1, 12)), i))
+
+    def run(pipeline):
+        eng = Engine(model, params, num_slots=3, max_len=64,
+                     pipeline=pipeline)
+        rids = [eng.submit(p, mnt, temperature=0.8, top_k=7, seed=100 + s)
+                for p, mnt, s in work]
+        res = {r.rid: r for r in eng.drain()}
+        return [(res[r].tokens, res[r].finish_reason) for r in rids]
+
+    assert run(True) == run(False)
+
+
+def test_batched_prefill_preserves_fifo_admission(served_model):
+    """With 2 slots and a same-bucket pair queued BEHIND a bucket fence,
+    the fenced request is admitted before later same-bucket ones (no
+    reorder for wave-packing); every output still exact."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    prompts = [[1] * 4, [2] * 20, [3] * 5, [4] * 6]  # buckets 16,32,16,16
+    rids = [eng.submit(p, 6) for p in prompts]
+    eng.step()  # first admission wave only has room for... slots=2
+    first_wave_rids = {st.req.rid for st in eng._active.values()}
+    # FIFO: the wave is [prompt0] alone (bucket fence at prompt1), then
+    # prompt1 takes the second slot in its own wave — prompts 2/3 (same
+    # bucket as 0) must NOT jump it.
+    assert first_wave_rids == {rids[0], rids[1]}
+    res = {r.rid: r for r in eng.drain()}
+    for rid, p in zip(rids, prompts):
+        assert res[rid].tokens == _ref_greedy(model, params, p, 6,
+                                              cfg.block_size)
+    _assert_compile_budget(eng)
+
+
+def test_stats_latency_fields(served_model):
+    """The observability satellite: /stats-visible latency signal —
+    tokens/sec, queue-wait, TTFT/TPOT percentiles from bounded rings."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    for i in range(5):
+        eng.submit([1 + i, 2, 3], 8, seed=i)
+    eng.drain()
+    s = eng.stats()
+    assert s["tokens_generated"] == 5 * 8
+    assert s["decode_tokens_per_sec"] is None or s["decode_tokens_per_sec"] > 0
+    assert s["queue_wait_steps_mean"] >= 0
+    for key in ("ttft_s", "tpot_s"):
+        pct = s[key]
+        assert set(pct) == {"p50", "p90", "p99"}
+        assert 0 <= pct["p50"] <= pct["p99"]
+    assert s["pipeline"] is True
+    assert s["admit_buckets"] == [1, 2]
 
 
 def test_sampled_output_independent_of_batch_composition(served_model):
@@ -303,10 +452,31 @@ def test_engine_loop_failure_fails_waiters_fast():
 def test_bench_decode_mode_emits_json():
     import bench
 
-    result = bench.bench_decode({"slots": "2", "max_new_tokens": "3",
+    result = bench.bench_decode({"num_slots": "2", "max_new_tokens": "3",
                                  "requests": "3"}, quick=True, on_tpu=False)
     assert result["unit"] == "tokens/sec"
     assert result["value"] > 0
-    assert result["extra"]["tokens_generated"] == 9
-    n_buckets = len(result["extra"]["prefill_buckets"])
-    assert sum(result["extra"]["trace_counts"].values()) <= n_buckets + 1
+    extra = result["extra"]
+    assert extra["tokens_generated"] == 9
+    # Pipelined-vs-synchronous comparison fields (trend-tracking, no
+    # threshold) + the latency signal.
+    assert extra["pipelined_tokens_per_sec"] > 0
+    assert extra["sync_tokens_per_sec"] > 0
+    assert extra["pipeline_speedup"] == pytest.approx(
+        extra["pipelined_tokens_per_sec"] / extra["sync_tokens_per_sec"])
+    assert set(extra["ttft_s"]) == {"p50", "p90", "p99"}
+    # Compile budget: the closed (admit-rung x bucket) grid.
+    budget = (len(extra["prefill_buckets"]) * len(extra["admit_buckets"])
+              + len(extra["admit_buckets"]) + 2)
+    assert sum(extra["trace_counts"].values()) <= budget
+    assert extra["trace_counts"]["decode"] == 1
+
+
+def test_bench_decode_mixed_mode():
+    import bench
+
+    result = bench.bench_decode({"num_slots": "2", "max_new_tokens": "4",
+                                 "requests": "4", "mixed": "1"},
+                                quick=True, on_tpu=False)
+    assert result["extra"]["mixed"] is True
+    assert 0 < result["extra"]["tokens_generated"] <= 16
