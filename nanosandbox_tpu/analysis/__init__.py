@@ -1,0 +1,40 @@
+"""jaxlint — first-party static analysis for the JAX/TPU invariants.
+
+The codebase's hardest-won performance invariants — a CLOSED compile
+set (``Engine.max_programs``), zero host syncs inside the decode/train
+hot loops, donation only on accelerators, no tracers leaking into
+Python control flow — are structural properties of the source, not of
+any one run. This package checks them with plain ``ast`` (no jax
+import, so the CI lint job needs nothing but a Python), as
+``python -m nanosandbox_tpu.analysis [--format=json] <paths>``.
+
+Rules (see docs/playbook.md "Static analysis" for the full catalogue):
+
+  host-sync        .item()/float()/int()/np.asarray/jax.device_get/
+                   print on device values in jit-traced code or in the
+                   host functions that drive compiled programs
+  tracer-leak      Python if/while/for/bool() conditioned on traced
+                   array values inside jit-traced functions
+  nonstatic-shape  arguments to compiled callables whose array shapes
+                   derive from unbucketed runtime values (len(...))
+  donation-misuse  reuse of a donated argument after the jit call;
+                   donate_argnums without an accelerator guard
+  impure-trace     np.random/time/global-state mutation inside
+                   jit-traced functions (side effects replay per trace)
+
+Suppress a deliberate violation with a REASONED comment (the reason is
+mandatory; a bare disable is itself a finding)::
+
+    x = np.asarray(toks)  # jaxlint: disable=host-sync -- readback feeds results
+
+The runtime half of the same contract lives in
+``nanosandbox_tpu.utils.tracecheck`` (retrace budgets + the blessed
+``host_sync`` readback wrapper, which this linter recognizes).
+"""
+
+from nanosandbox_tpu.analysis.core import (Finding, Rule, all_rules,
+                                           analyze_paths, analyze_source,
+                                           render_json, render_text)
+
+__all__ = ["Finding", "Rule", "all_rules", "analyze_paths",
+           "analyze_source", "render_json", "render_text"]

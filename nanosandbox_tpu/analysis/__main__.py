@@ -1,0 +1,67 @@
+"""CLI: ``python -m nanosandbox_tpu.analysis [options] <paths>``.
+
+Exit status is the CI gate: 0 clean, 1 findings, 2 usage error. The
+JSON report (``--format=json``, optionally ``--out=FILE`` so CI can
+upload it as an artifact while the text summary still lands in the
+log) is schema-versioned — see docs/playbook.md "Static analysis".
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nanosandbox_tpu.analysis",
+        description="jaxlint: static analysis for the stack's JAX/TPU "
+                    "invariants (host syncs, tracer leaks, shape "
+                    "bucketing, donation, trace purity)")
+    ap.add_argument("paths", nargs="*", default=["nanosandbox_tpu"],
+                    help="files or directories to lint "
+                         "(default: nanosandbox_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the report to FILE (JSON when "
+                         "--format=json; CI uploads this as an artifact)")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    from nanosandbox_tpu.analysis.core import (all_rules, analyze_paths,
+                                               render_json, render_text)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}: {rule.doc}")
+        return 0
+
+    select = ([r.strip() for r in args.select.split(",") if r.strip()]
+              if args.select else None)
+    try:
+        report = analyze_paths(args.paths, select=select)
+    except ValueError as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    if report["summary"]["files_scanned"] == 0:
+        print(f"jaxlint: no Python files under {args.paths!r}",
+              file=sys.stderr)
+        return 2
+
+    rendered = (render_json(report) if args.format == "json"
+                else render_text(report))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(rendered + "\n")
+        # The log still gets the human-readable summary.
+        print(render_text(report))
+    else:
+        print(rendered)
+    return 1 if report["summary"]["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

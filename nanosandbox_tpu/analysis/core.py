@@ -1,0 +1,285 @@
+"""jaxlint framework: findings, suppressions, rule registry, reports.
+
+Deliberately jax-free (pure ``ast`` + stdlib): the CI lint job runs on
+a bare Python, and importing jax just to read source would drag the
+whole accelerator runtime into a linter. Rules get a ``ModuleContext``
+(parsed tree + the jit-scope index from jitscope.py) and yield
+``Finding``s; this module owns everything around them — file walking,
+``# jaxlint: disable=<rule> -- <reason>`` suppression comments (the
+reason is mandatory), and the text/JSON renderers the CI gate consumes.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+JSON_SCHEMA_VERSION = 1
+
+# `# jaxlint: disable=host-sync,tracer-leak -- why this is deliberate`
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*?))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    file: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def key(self) -> Tuple:
+        return (self.file, self.line, self.col, self.rule, self.message)
+
+
+@dataclass
+class Suppression:
+    line: int               # line the comment sits on
+    rules: Tuple[str, ...]  # rule ids; ("all",) disables every rule
+    reason: str             # mandatory — empty means the disable is void
+    standalone: bool        # comment-only line: applies to the NEXT stmt line
+    used: bool = False
+
+    def covers(self, rule: str) -> bool:
+        return "all" in self.rules or rule in self.rules
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule needs about one source file."""
+    path: str
+    source: str
+    tree: ast.Module
+    index: object            # jitscope.ModuleIndex (typed loosely: no cycle)
+    lines: List[str] = field(default_factory=list)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``doc`` and implement check().
+
+    Adding a rule = subclass + register() — see docs/playbook.md
+    "Static analysis: adding a rule".
+    """
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: Callable[[], Rule]):
+    """Class decorator: instantiate and add to the global rule registry."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"rule {rule_cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_builtin_rules()
+    return dict(_REGISTRY)
+
+
+_BUILTINS_LOADED = False
+
+
+def _load_builtin_rules() -> None:
+    # Import for the @register side effect; deferred so `import
+    # nanosandbox_tpu.analysis.core` alone never half-registers.
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    from nanosandbox_tpu.analysis import (rules_donation,  # noqa: F401
+                                          rules_sync, rules_tracer)
+
+
+# ---------------------------------------------------------------- suppression
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    """Extract jaxlint disable comments via tokenize (not regex over raw
+    lines: a '# jaxlint:' inside a string literal must not suppress)."""
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        standalone = tok.line.strip().startswith("#")
+        out.append(Suppression(line=tok.start[0], rules=rules,
+                               reason=reason, standalone=standalone))
+    return out
+
+
+def _suppression_for(sup: List[Suppression], finding: Finding,
+                     lines: List[str]) -> Optional[Suppression]:
+    for s in sup:
+        if not s.covers(finding.rule):
+            continue
+        # Same-line, or a standalone comment above with NOTHING but
+        # comments/blank lines in between (stacked disables + prose are
+        # fine; a code line in between would let the disable silently
+        # swallow a later, unaudited violation on it).
+        if s.line == finding.line:
+            return s
+        if s.standalone and s.line < finding.line:
+            between = lines[s.line:finding.line - 1]
+            if all(not ln.strip() or ln.lstrip().startswith("#")
+                   for ln in between):
+                return s
+    return None
+
+
+# ------------------------------------------------------------------ analysis
+
+def analyze_source(source: str, path: str = "<string>",
+                   select: Optional[Sequence[str]] = None,
+                   ) -> Tuple[List[Finding], int]:
+    """Lint one source string. Returns (findings, suppressed_count).
+
+    ``select`` restricts to a subset of rule ids (the fixture tests use
+    it to pin each rule to its known-bad twin in isolation).
+    """
+    from nanosandbox_tpu.analysis.jitscope import ModuleIndex
+
+    rules = all_rules()
+    if select:
+        unknown = sorted(set(select) - set(rules))
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(unknown)}; "
+                             f"known: {', '.join(sorted(rules))}")
+        rules = {k: v for k, v in rules.items() if k in select}
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "parse-error",
+                        f"could not parse: {e.msg}")], 0
+
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        index=ModuleIndex(tree), lines=source.splitlines())
+    raw: List[Finding] = []
+    for rule in rules.values():
+        raw.extend(rule.check(ctx))
+
+    suppressions = parse_suppressions(source)
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in sorted(set(raw), key=lambda f: f.key()):
+        s = _suppression_for(suppressions, f, ctx.lines)
+        if s is None:
+            findings.append(f)
+        elif not s.reason:
+            # A bare disable is void AND a finding (below): the tool's
+            # contract is that every deliberate violation carries its why.
+            s.used = True
+            findings.append(f)
+        else:
+            s.used = True
+            suppressed += 1
+    # Malformed suppressions are findings whether or not they matched
+    # anything — a typo'd rule id or a bare disable must not sit inert
+    # while the author believes the violation is audited.
+    known = set(all_rules()) | {"all", "parse-error", "bad-suppression"}
+    for s in suppressions:
+        if not s.reason:
+            findings.append(Finding(
+                path, s.line, 0, "bad-suppression",
+                "suppression without a reason — write "
+                "'# jaxlint: disable=<rule> -- <why this is deliberate>'"))
+        for r in s.rules:
+            if r not in known:
+                findings.append(Finding(
+                    path, s.line, 0, "bad-suppression",
+                    f"unknown rule id {r!r} in suppression — known: "
+                    f"{', '.join(sorted(set(all_rules())))}"))
+    return sorted(set(findings), key=lambda f: f.key()), suppressed
+
+
+def iter_python_files(paths: Sequence[str]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    # De-dup while preserving order (a file listed and inside a dir).
+    seen = set()
+    out = []
+    for f in files:
+        r = f.resolve()
+        if r not in seen and "__pycache__" not in f.parts:
+            seen.add(r)
+            out.append(f)
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Sequence[str]] = None) -> dict:
+    """Lint files/directories; returns the report dict render_json dumps."""
+    findings: List[Finding] = []
+    suppressed = 0
+    files = iter_python_files(paths)
+    for f in files:
+        try:
+            src = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(str(f), 1, 0, "parse-error",
+                                    f"could not read: {e}"))
+            continue
+        fs, sup = analyze_source(src, str(f), select=select)
+        findings.extend(fs)
+        suppressed += sup
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "jaxlint",
+        "findings": [vars(f) for f in findings],
+        "summary": {
+            "files_scanned": len(files),
+            "findings": len(findings),
+            "suppressed": suppressed,
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+    }
+
+
+# ------------------------------------------------------------------- reports
+
+def render_text(report: dict) -> str:
+    lines = [f"{f['file']}:{f['line']}:{f['col']}: {f['rule']}: "
+             f"{f['message']}" for f in report["findings"]]
+    s = report["summary"]
+    lines.append(f"jaxlint: {s['findings']} finding(s) in "
+                 f"{s['files_scanned']} file(s), "
+                 f"{s['suppressed']} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=False)

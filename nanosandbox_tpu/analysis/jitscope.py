@@ -1,0 +1,486 @@
+"""Jit-scope index: which functions are traced, which drive compiled code.
+
+Every jaxlint rule needs the same two questions answered about a module:
+
+  * TRACED set — functions whose bodies run under a jax trace: anything
+    referenced from a ``jax.jit``/``@jit``/``partial(jax.jit, ...)``
+    root (or a scan/grad/vmap-style trace wrapper), closed transitively
+    over in-module references, plus functions nested inside traced ones.
+    Host syncs here are trace-time bugs; Python control flow on traced
+    arrays is a tracer leak; side effects replay once per retrace.
+
+  * DISPATCHER set — host functions that CALL compiled programs (the
+    hot loops AROUND the jit): a function calling a name bound to a
+    ``jax.jit(...)`` result (``self._decode = jax.jit(...)`` anywhere in
+    the class counts class-wide), or one of the KNOWN_COMPILED entry
+    points the stack threads through opaque plumbing (``train_step`` /
+    ``eval_step`` from ``Trainer.compiled_steps``), closed over the
+    private helpers they reference (``Engine.step -> Engine._retire``).
+    Host syncs here serialize the device pipeline — the perf bug class.
+
+Analysis is per-module and purely syntactic: no imports are resolved,
+no types inferred. The DeviceTracker below is the same spirit — a value
+is "device" when the source SAYS so (result of a jnp/lax call, of a
+compiled callable, of ``.apply``; propagated through assignments,
+unpacking, arithmetic and comprehension targets) and a parameter counts
+once the body treats it like an array (``x.shape``, ``x.astype``,
+``x.at[...]`` ...). Heuristic by design: the rules only fire where the
+evidence is written down, which keeps false positives near zero at the
+cost of missing what plumbing hides (documented in the playbook).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set
+
+# Callees whose function-valued arguments are traced by jax.
+TRACE_WRAPPERS = {
+    "jit", "grad", "value_and_grad", "vmap", "pmap", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "shard_map", "remat",
+    "checkpoint", "eval_shape", "custom_vjp", "custom_jvp",
+}
+
+# Compiled entry points threaded through plumbing the per-module
+# analysis cannot see (Trainer.compiled_steps returns these). Extend
+# when you add a compiled entry point that travels through a tuple.
+KNOWN_COMPILED = {"train_step", "eval_step"}
+
+# Attribute accesses that mark a name as array-like (evidence).
+ARRAY_EVIDENCE_ATTRS = {
+    "shape", "ndim", "dtype", "astype", "at", "item", "reshape", "sum",
+    "mean", "T", "transpose", "take", "squeeze", "ravel", "flatten",
+    "block_until_ready", "sharding",
+}
+
+# Attribute reads that are STATIC under a trace (never a tracer).
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# Calls that launder a value into a static/host fact.
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                "callable", "id", "repr"}
+
+_DEVICE_ROOTS = {"jnp", "lax"}
+_DEVICE_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.")
+_DEVICE_EXACT = {"jax.device_put", "jax.make_array_from_process_local_data"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements, NOT nested def/class bodies
+    (those are indexed as their own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    bare_name: str
+    node: ast.AST
+    parent_class: Optional[str] = None
+    parent_fn: Optional[str] = None     # qualname of enclosing function
+    params: List[str] = field(default_factory=list)
+
+    @property
+    def is_private(self) -> bool:
+        return (self.bare_name.startswith("_")
+                and not self.bare_name.startswith("__"))
+
+
+@dataclass
+class JitCallInfo:
+    """One jax.jit(...) call site — the donation rule's raw material."""
+    node: ast.Call
+    donate: Optional[ast.expr]          # the donate_argnums value, if any
+    target: Optional[str]               # bound name ('_decode', 'gen'), if any
+    enclosing: Optional[str]            # qualname of the enclosing function
+    lineno: int = 0
+
+
+class ModuleIndex:
+    """Per-module jit-scope facts; built once, shared by every rule."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_bare: Dict[str, List[str]] = {}
+        self.jit_calls: List[JitCallInfo] = []
+        self.compiled_names: Set[str] = set(KNOWN_COMPILED)
+        self.jit_roots: Set[str] = set()
+        self.traced: Set[str] = set()
+        self.dispatchers: Set[str] = set()
+
+        self._collect_functions(tree)
+        self._collect_jit_sites()
+        self._close_traced()
+        self._close_dispatchers()
+
+    # ------------------------------------------------------------ collection
+
+    def _collect_functions(self, tree: ast.Module) -> None:
+        def visit(node: ast.AST, cls: Optional[str], fn: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(p for p in (cls, fn, child.name) if p)
+                    a = child.args
+                    params = [x.arg for x in
+                              (a.posonlyargs + a.args + a.kwonlyargs)]
+                    if a.vararg:
+                        params.append(a.vararg.arg)
+                    if a.kwarg:
+                        params.append(a.kwarg.arg)
+                    info = FunctionInfo(qualname=qual, bare_name=child.name,
+                                        node=child, parent_class=cls,
+                                        parent_fn=fn, params=params)
+                    self.functions[qual] = info
+                    self._by_bare.setdefault(child.name, []).append(qual)
+                    visit(child, cls, qual)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, None)
+                else:
+                    visit(child, cls, fn)
+        visit(tree, None, None)
+
+    def enclosing_function(self, lineno: int) -> Optional[FunctionInfo]:
+        best = None
+        for info in self.functions.values():
+            n = info.node
+            if n.lineno <= lineno <= (n.end_lineno or n.lineno):
+                if best is None or n.lineno > best.node.lineno:
+                    best = info
+        return best
+
+    def _fn_refs(self, expr: ast.AST,
+                 enclosing: Optional[FunctionInfo],
+                 _depth: int = 0) -> Set[str]:
+        """Function qualnames referenced by ``expr`` — following one or
+        two levels of local-variable indirection (``step = partial(f)``;
+        ``step = guard(step)``; ``jax.jit(step)``)."""
+        refs: Set[str] = set()
+        local_names: Set[str] = set()
+        for node in ast.walk(expr):
+            name = None
+            if isinstance(node, ast.Attribute):
+                # Only `self.<method>` references count: a deeper chain
+                # like `self.cfg.memory_report` is data, and matching
+                # its terminal against a method name poisons the root
+                # set through local-variable resolution.
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if not name:
+                continue
+            for qual in self._by_bare.get(name, ()):
+                refs.add(qual)
+            if isinstance(node, ast.Name) and name not in self._by_bare:
+                local_names.add(name)
+        if enclosing is not None and _depth < 2 and local_names:
+            for stmt in walk_body(enclosing.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                targets = {t.id for t in stmt.targets
+                           if isinstance(t, ast.Name)}
+                if targets & local_names:
+                    refs |= self._fn_refs(stmt.value, enclosing, _depth + 1)
+        return refs
+
+    def _collect_jit_sites(self) -> None:
+        # Decorator roots: @jax.jit / @jit / @partial(jax.jit, ...).
+        for info in self.functions.values():
+            for dec in getattr(info.node, "decorator_list", []):
+                names = {terminal_name(n) for n in ast.walk(dec)
+                         if isinstance(n, (ast.Name, ast.Attribute))}
+                if "jit" in names or "pmap" in names:
+                    self.jit_roots.add(info.qualname)
+                    # A decorated def IS the compiled callable: calling
+                    # it by name dispatches a compiled program.
+                    self.compiled_names.add(info.bare_name)
+
+        # Call-site roots: jax.jit(f, ...), lax.scan(body, ...), etc.
+        # ast.walk yields an Assign before its value Call, so the seen
+        # set keeps `x = jax.jit(...)` from being indexed twice.
+        seen: Set[int] = set()
+        for node in ast.walk(self.tree):
+            target = None
+            call = None
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                call = node.value
+                seen.add(id(call))
+                if len(node.targets) == 1:
+                    target = terminal_name(node.targets[0])
+            elif isinstance(node, ast.Call):
+                if id(node) in seen:
+                    continue
+                call = node
+            if call is None:
+                continue
+            callee = terminal_name(call.func)
+            if callee not in TRACE_WRAPPERS:
+                continue
+            enclosing = self.enclosing_function(call.lineno)
+            refs: Set[str] = set()
+            for arg in list(call.args) + [k.value for k in call.keywords
+                                          if k.arg != "donate_argnums"]:
+                refs |= self._fn_refs(arg, enclosing)
+            self.jit_roots |= refs
+            if callee == "jit":
+                donate = next((k.value for k in call.keywords
+                               if k.arg == "donate_argnums"), None)
+                if target:
+                    self.compiled_names.add(target)
+                enc_qual = enclosing.qualname if enclosing else None
+                # Only record direct jax.jit assignments/calls (the
+                # donation rule keys on these; nested wrappers came in
+                # through refs already).
+                if isinstance(node, ast.Assign) or donate is not None:
+                    self.jit_calls.append(JitCallInfo(
+                        node=call, donate=donate, target=target,
+                        enclosing=enc_qual, lineno=call.lineno))
+
+    # -------------------------------------------------------------- closures
+
+    def _referenced_names(self, info: FunctionInfo) -> Iterator[str]:
+        """Bare names a function body references (plain Name loads and
+        `self.<attr>` — the two forms the per-module index can bind)."""
+        for node in walk_body(info.node):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name) and node.value.id == "self":
+                yield node.attr
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                yield node.id
+
+    def _close_over(self, roots: Set[str], follow) -> Set[str]:
+        """Transitive closure of ``roots`` over in-module references
+        whose bare name passes ``follow``; nested defs always join
+        their parent (they only exist inside it)."""
+        done: Set[str] = set()
+        pending = list(roots)
+        while pending:
+            qual = pending.pop()
+            if qual in done or qual not in self.functions:
+                continue
+            done.add(qual)
+            for other in self.functions.values():
+                if other.parent_fn == qual:
+                    pending.append(other.qualname)
+            for name in self._referenced_names(self.functions[qual]):
+                if follow(name):
+                    pending.extend(self._by_bare.get(name, ()))
+        return done
+
+    def _close_traced(self) -> None:
+        self.traced = self._close_over(self.jit_roots, lambda name: True)
+
+    def _close_dispatchers(self) -> None:
+        direct: Set[str] = set()
+        for info in self.functions.values():
+            for node in walk_body(info.node):
+                if (isinstance(node, ast.Call)
+                        and terminal_name(node.func) in self.compiled_names):
+                    direct.add(info.qualname)
+                    break
+        # Close over PRIVATE helpers only: the hot loop's internals are
+        # underscore-named by convention; public siblings (restore,
+        # pretrained import...) are setup code, not the loop.
+        self.dispatchers = self._close_over(
+            direct,
+            lambda name: name.startswith("_") and not name.startswith("__"))
+
+    # ------------------------------------------------------------- utilities
+
+    def hot_scope(self) -> Set[str]:
+        """Functions where a host sync is a finding: traced bodies plus
+        the host loops that drive compiled programs."""
+        return self.traced | self.dispatchers
+
+
+class DeviceTracker:
+    """Syntactic device-value propagation inside ONE function body."""
+
+    def __init__(self, info: FunctionInfo, index: ModuleIndex,
+                 params_are_device: bool = False):
+        self.info = info
+        self.index = index
+        self.device: Set[str] = set()
+        if params_are_device:
+            self.device |= {p for p in info.params if p != "self"}
+        else:
+            self.device |= self._evidenced_params()
+        # Two passes: later assignments can feed earlier uses in loops.
+        for _ in range(2):
+            self._propagate()
+
+    def _evidenced_params(self) -> Set[str]:
+        out: Set[str] = set()
+        params = set(self.info.params) - {"self"}
+        for node in walk_body(self.info.node):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in params
+                    and node.attr in ARRAY_EVIDENCE_ATTRS):
+                out.add(node.value.id)
+        return out
+
+    # -------------------------------------------------------------- plumbing
+
+    def _mark(self, target: ast.AST, device: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._mark(el, device)
+            return
+        name = dotted_name(target)
+        if not name:
+            return
+        if device:
+            self.device.add(name)
+        else:
+            self.device.discard(name)
+
+    def _propagate(self) -> None:
+        for node in walk_body(self.info.node):
+            if isinstance(node, ast.Assign):
+                dev = self.is_device(node.value)
+                host = self._is_host_call(node.value)
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and isinstance(
+                            node.value, (ast.Tuple, ast.List)):
+                        for el, v in zip(t.elts, node.value.elts):
+                            self._mark(el, self.is_device(v))
+                    elif dev:
+                        self._mark(t, True)
+                    elif host:
+                        self._mark(t, False)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if self.is_device(node.value):
+                    self._mark(node.target, True)
+            elif isinstance(node, ast.AugAssign):
+                if self.is_device(node.value):
+                    self._mark(node.target, True)
+            elif isinstance(node, ast.For):
+                if self.is_device(node.iter):
+                    self._mark(node.target, True)
+            elif isinstance(node, ast.comprehension):
+                if self.is_device(node.iter):
+                    self._mark(node.target, True)
+            elif isinstance(node, (ast.NamedExpr,)):
+                if self.is_device(node.value):
+                    self._mark(node.target, True)
+
+    def _is_host_call(self, expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        name = dotted_name(expr.func) or ""
+        root = name.split(".")[0]
+        return root in {"np", "numpy"} or name == "jax.device_get"
+
+    # ------------------------------------------------------------ the oracle
+
+    def is_device(self, expr: ast.AST) -> bool:
+        """Does this expression produce (or contain) a device value?"""
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if name:
+                root = name.split(".")[0]
+                term = name.split(".")[-1]
+                if root in {"np", "numpy"}:
+                    return False
+                if name == "jax.device_get":
+                    return False
+                if root in _DEVICE_ROOTS or name in _DEVICE_EXACT \
+                        or name.startswith(_DEVICE_PREFIXES):
+                    return True
+                if term in self.index.compiled_names:
+                    return True
+                if term == "apply":     # flax Module.apply
+                    return True
+            # method call on a device value: jnp.stack(x).mean()
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr not in STATIC_ATTRS \
+                    and self.is_device(expr.func.value):
+                return True
+            return False
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            name = dotted_name(expr)
+            if name in self.device:
+                return True
+            if isinstance(expr, ast.Attribute):
+                if expr.attr in STATIC_ATTRS:
+                    return False
+                return self.is_device(expr.value)
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self.is_device(expr.value)
+        if isinstance(expr, ast.BinOp):
+            return self.is_device(expr.left) or self.is_device(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_device(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_device(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            return self.is_device(expr.left) or any(
+                self.is_device(c) for c in expr.comparators)
+        if isinstance(expr, ast.IfExp):
+            return self.is_device(expr.body) or self.is_device(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_device(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.is_device(expr.value)
+        return False
+
+    def test_is_dynamic(self, test: ast.expr) -> bool:
+        """True when a condition depends on a traced value at RUNTIME —
+        static introspection (``x.shape``, ``len(x)``, ``x is None``,
+        ``isinstance``) is stripped before the device check."""
+        def dynamic(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+                return False
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                term = (name or "").split(".")[-1]
+                if term in STATIC_CALLS:
+                    return False
+                return self.is_device(node) or any(
+                    dynamic(a) for a in node.args)
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+                return False                     # `x is None` is static
+            if isinstance(node, (ast.Name,)):
+                return node.id in self.device
+            if isinstance(node, ast.Subscript):
+                return dynamic(node.value)
+            for child in ast.iter_child_nodes(node):
+                if dynamic(child):
+                    return True
+            return False
+        return dynamic(test)

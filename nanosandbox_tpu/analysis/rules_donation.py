@@ -1,0 +1,171 @@
+"""donation-misuse: donated buffers are gone; donation is TPU-only.
+
+Two failure modes around ``jax.jit(..., donate_argnums=...)``:
+
+  * Reuse after donation — a donated argument's buffer is aliased into
+    the output; reading the old handle after the call returns garbage
+    (or a deleted-buffer error) on accelerators while silently WORKING
+    on CPU, where jit ignores donation. The engine's discipline is to
+    rebind in the same statement (``self._pool, toks =
+    self._prefill(params, self._pool, ...)``) — anything else is a
+    latent TPU-only bug.
+
+  * Unguarded donation — CPU jit ignores ``donate_argnums`` and warns
+    on every compile; the stack's convention is the engine's
+    accelerator gate: ``donate_argnums=(1,) if on_accel else ()`` with
+    ``on_accel = jax.default_backend() != "cpu"``. A bare literal tuple
+    means every CPU test run churns warnings and documents the wrong
+    contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from nanosandbox_tpu.analysis.core import (Finding, ModuleContext, Rule,
+                                           register)
+from nanosandbox_tpu.analysis.jitscope import (dotted_name, terminal_name,
+                                               walk_body)
+
+
+def _donated_positions(donate: ast.expr) -> Tuple[int, ...]:
+    """Static positions from the donate_argnums expression; for the
+    guarded form ``(...) if on_accel else ()`` the accelerator branch
+    is the contract."""
+    if isinstance(donate, ast.IfExp):
+        donate = donate.body
+    if isinstance(donate, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in donate.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    if isinstance(donate, ast.Constant) and isinstance(donate.value, int):
+        return (donate.value,)
+    return ()
+
+
+@register
+class DonationMisuseRule(Rule):
+    id = "donation-misuse"
+    doc = ("reuse of a donated argument after the jit call, and "
+           "donate_argnums without the accelerator guard (CPU jit "
+           "ignores donation and warns)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        idx = ctx.index
+        for jc in idx.jit_calls:
+            if jc.donate is None:
+                continue
+            if not self._is_guarded(jc, idx):
+                out.append(Finding(
+                    ctx.path, jc.lineno, jc.node.col_offset, self.id,
+                    "donate_argnums without an accelerator guard: CPU "
+                    "jit ignores donation and warns every compile — "
+                    "write `donate_argnums=(...) if on_accel else ()` "
+                    "with `on_accel = jax.default_backend() != \"cpu\"`"))
+            if jc.target:
+                out.extend(self._check_reuse(ctx, jc))
+        return out
+
+    # ---------------------------------------------------------------- guards
+
+    def _is_guarded(self, jc, idx) -> bool:
+        donate = jc.donate
+        if isinstance(donate, ast.IfExp):
+            return True
+        if isinstance(donate, (ast.Tuple, ast.List)) and not donate.elts:
+            return True                          # () donates nothing
+        if isinstance(donate, ast.Name):
+            # A name bound to a guarded expression counts; an unresolved
+            # name is given the benefit of the doubt (no type info).
+            enc = idx.functions.get(jc.enclosing) if jc.enclosing else None
+            if enc is None:
+                return True
+            for node in walk_body(enc.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == donate.id
+                        for t in node.targets):
+                    if not isinstance(node.value, ast.IfExp):
+                        return False
+            return True
+        return False
+
+    # ----------------------------------------------------------- reuse check
+
+    def _check_reuse(self, ctx: ModuleContext, jc) -> List[Finding]:
+        out: List[Finding] = []
+        positions = _donated_positions(jc.donate)
+        if not positions:
+            return out
+        idx = ctx.index
+        for info in idx.functions.values():
+            for node in walk_body(info.node):
+                if not (isinstance(node, ast.Call)
+                        and terminal_name(node.func) == jc.target):
+                    continue
+                donated: List[str] = []
+                for pos in positions:
+                    if pos < len(node.args):
+                        name = dotted_name(node.args[pos])
+                        if name:
+                            donated.append(name)
+                if not donated:
+                    continue
+                rebound = self._rebound_by(info.node, node)
+                for name in donated:
+                    if name in rebound:
+                        continue
+                    reuse = self._load_after(info.node, node, name)
+                    if reuse is not None:
+                        out.append(Finding(
+                            ctx.path, reuse.lineno, reuse.col_offset,
+                            self.id,
+                            f"`{name}` was donated to compiled "
+                            f"`{jc.target}` on line {node.lineno} — its "
+                            "buffer is aliased into the output and this "
+                            "read returns garbage on accelerators "
+                            "(rebind the result over the donated "
+                            "operand in the same statement)"))
+        return out
+
+    def _rebound_by(self, fn: ast.AST, call: ast.Call) -> Set[str]:
+        """Targets of the assignment whose value is this call."""
+        for node in walk_body(fn):
+            if isinstance(node, ast.Assign) and node.value is call:
+                names: Set[str] = set()
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            n = dotted_name(el)
+                            if n:
+                                names.add(n)
+                    else:
+                        n = dotted_name(t)
+                        if n:
+                            names.add(n)
+                return names
+        return set()
+
+    def _load_after(self, fn: ast.AST, call: ast.Call,
+                    name: str) -> Optional[ast.AST]:
+        """First Load of ``name`` after the call line, stopping at a
+        rebind (a Store of the same name ends the donated lifetime)."""
+        candidates = []
+        for node in walk_body(fn):
+            lineno = getattr(node, "lineno", None)
+            if lineno is None or lineno <= call.end_lineno:
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and dotted_name(node) == name:
+                candidates.append(node)
+        if not candidates:
+            return None
+        candidates.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in candidates:
+            ctx_ = getattr(node, "ctx", None)
+            if isinstance(ctx_, ast.Store):
+                return None
+            if isinstance(ctx_, ast.Load):
+                return node
+        return None

@@ -1,0 +1,193 @@
+"""tracer-leak and nonstatic-shape: what jit specializes on must be static.
+
+tracer-leak — Python ``if``/``while``/``for``/``bool()`` on a traced
+array forces concretization: at best a ConcretizationTypeError at trace
+time, at worst (via a Python scalar that jit re-specializes on) a fresh
+compile per distinct value. Static introspection is fine and stripped
+before the check: ``x.shape``/``x.ndim``/``x.dtype``, ``len(x)``,
+``isinstance``, ``x is None``.
+
+nonstatic-shape — the bug class the prefill bucket ladder exists to
+prevent: a compiled program's operand shapes must come from a CLOSED
+set, so any shape that reaches a jitted call site carrying a raw
+``len(...)`` of runtime data (a queue, a wave, a batch list) is an
+unbounded compile family. The rule follows shape expressions through
+local assignments and accepts values laundered through a bucketing
+function (callee name containing bucket/rung/ladder/pad — e.g.
+``scheduler.rung_for``/``bucket_for``), which is exactly the engine's
+admission discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from nanosandbox_tpu.analysis.core import (Finding, ModuleContext, Rule,
+                                           register)
+from nanosandbox_tpu.analysis.jitscope import (DeviceTracker, dotted_name,
+                                               terminal_name, walk_body)
+
+_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange"}
+_BUCKET_WORDS = ("bucket", "rung", "ladder", "pad", "pow2", "next_power")
+_RESOLVE_DEPTH = 8
+
+
+@register
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    doc = ("Python if/while/for/bool() conditioned on traced array "
+           "values inside jit-traced functions")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        idx = ctx.index
+        out: List[Finding] = []
+        for qual in sorted(idx.traced & set(idx.functions)):
+            info = idx.functions[qual]
+            tracker = DeviceTracker(info, idx)
+            for node in walk_body(info.node):
+                if isinstance(node, (ast.If, ast.While)) \
+                        and tracker.test_is_dynamic(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"Python `{kind}` on a traced value in {qual}: "
+                        "use lax.cond/lax.select/jnp.where (shapes, "
+                        "dtypes and `is None` checks stay static)"))
+                elif isinstance(node, ast.IfExp) \
+                        and tracker.test_is_dynamic(node.test):
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"conditional expression on a traced value in "
+                        f"{qual}: use jnp.where/lax.select"))
+                elif isinstance(node, ast.For) \
+                        and tracker.is_device(node.iter):
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"Python `for` over a traced array in {qual} "
+                        "unrolls per element at trace time: use "
+                        "lax.scan/lax.fori_loop"))
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Name)
+                      and node.func.id == "bool" and node.args
+                      and tracker.is_device(node.args[0])):
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"bool() on a traced array in {qual} forces "
+                        "concretization at trace time"))
+        return out
+
+
+@register
+class NonstaticShapeRule(Rule):
+    id = "nonstatic-shape"
+    doc = ("arguments to compiled callables whose array shapes derive "
+           "from unbucketed runtime values (raw len(...) reaching a "
+           "jitted call site)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        idx = ctx.index
+        out: List[Finding] = []
+        for info in idx.functions.values():
+            for node in walk_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = terminal_name(node.func)
+                if callee not in idx.compiled_names:
+                    continue
+                for arg in node.args:
+                    bad = self._dynamic_shape_source(arg, info.node,
+                                                     node.lineno)
+                    if bad is not None:
+                        out.append(Finding(
+                            ctx.path, node.lineno, node.col_offset,
+                            self.id,
+                            f"argument `{ast.unparse(arg)}` to compiled "
+                            f"`{callee}` has a shape derived from "
+                            f"`{ast.unparse(bad)}` — every distinct "
+                            "value is a fresh XLA compile; pad through "
+                            "a bucket ladder (scheduler.bucket_for/"
+                            "rung_for)"))
+        return out
+
+    # ------------------------------------------------------------- resolvers
+
+    def _last_assign(self, fn: ast.AST, name: str,
+                     before: int) -> Optional[Tuple[ast.expr, int]]:
+        best: Optional[Tuple[ast.expr, int]] = None
+        for node in walk_body(fn):
+            if not isinstance(node, ast.Assign) or node.lineno >= before:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    if best is None or node.lineno > best[1]:
+                        best = (node.value, node.lineno)
+        return best
+
+    def _constructor_of(self, expr: ast.expr, fn: ast.AST, before: int,
+                        depth: int = 0) -> Optional[Tuple[ast.Call, int]]:
+        """The np/jnp.zeros|ones|full|empty call an argument expression
+        bottoms out in, following asarray() wraps and local assignment
+        chains (returns the call plus the lineno context to resolve its
+        shape names at)."""
+        if depth > _RESOLVE_DEPTH:
+            return None
+        if isinstance(expr, ast.Call):
+            term = terminal_name(expr.func)
+            if term in _CONSTRUCTORS:
+                return expr, before
+            if term == "asarray" and expr.args:
+                return self._constructor_of(expr.args[0], fn, before,
+                                            depth + 1)
+            return None
+        if isinstance(expr, ast.Name):
+            got = self._last_assign(fn, expr.id, before)
+            if got is None:
+                return None
+            return self._constructor_of(got[0], fn, got[1], depth + 1)
+        return None
+
+    def _dynamic_shape_source(self, arg: ast.expr, fn: ast.AST,
+                              before: int) -> Optional[ast.expr]:
+        got = self._constructor_of(arg, fn, before)
+        if got is None:
+            return None
+        ctor, lineno = got
+        if not ctor.args:
+            return None
+        shape = ctor.args[0]
+        elems = shape.elts if isinstance(shape, (ast.Tuple, ast.List)) \
+            else [shape]
+        for el in elems:
+            bad = self._offender(el, fn, lineno, 0)
+            if bad is not None:
+                return bad
+        return None
+
+    def _offender(self, el: ast.expr, fn: ast.AST, before: int,
+                  depth: int) -> Optional[ast.expr]:
+        """The unlaundered len(...) feeding a shape element, if any."""
+        if depth > _RESOLVE_DEPTH:
+            return None
+        if isinstance(el, ast.Constant) or isinstance(el, ast.Attribute):
+            return None
+        if isinstance(el, ast.Call):
+            term = terminal_name(el.func) or ""
+            if any(w in term for w in _BUCKET_WORDS):
+                return None                      # laundered: bucketed
+            if term == "len":
+                return el
+            for a in el.args:                    # e.g. max(len(q), 1)
+                bad = self._offender(a, fn, before, depth + 1)
+                if bad is not None:
+                    return bad
+            return None
+        if isinstance(el, ast.BinOp):
+            return (self._offender(el.left, fn, before, depth + 1)
+                    or self._offender(el.right, fn, before, depth + 1))
+        if isinstance(el, ast.Name):
+            got = self._last_assign(fn, el.id, before)
+            if got is None:
+                return None
+            return self._offender(got[0], fn, got[1], depth + 1)
+        return None

@@ -121,6 +121,7 @@ def _sample_token_rows(logits_i, rng, *, temperature, top_k, top_p):
         jnp.arange(B)[:, None], sort_idx].set(keep_sorted)
     x = jnp.where(keep, x, -1e30)
 
+    # jaxlint: disable=tracer-leak -- _is_key_batch reads dtype/ndim only (static)
     if _is_key_batch(rng):
         sampled = jax.vmap(jax.random.categorical)(rng, x).astype(jnp.int32)
     else:
@@ -287,6 +288,7 @@ def main(argv: list[str] | None = None) -> list[str]:
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from nanosandbox_tpu.data.loader import BinDataset
     from nanosandbox_tpu.data.tokenizer import get_tokenizer
@@ -309,8 +311,13 @@ def main(argv: list[str] | None = None) -> list[str]:
                           temperature=args.temperature, top_k=args.top_k,
                           top_p=args.top_p, block_size=cfg.block_size))
     out = gen(params, idx, rng=rng)
+    # ONE batched readback, then host-side decode: int() per element of
+    # a live device array costs a device->host round trip PER TOKEN
+    # (jaxlint host-sync caught this one).
+    # jaxlint: disable=host-sync -- the single final readback of the samples
+    out_host = np.asarray(out)
     texts = []
-    for row in out:
+    for row in out_host:
         text = tok.decode([int(t) for t in row])
         texts.append(text)
         print(text)

@@ -12,6 +12,7 @@ serving dtype, and exposes the continuous-batching engine over HTTP:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 
 
@@ -67,21 +68,30 @@ def main(argv: list[str] | None = None) -> None:
     lo = 1
     for bucket in engine.sched.buckets:
         # Warmup prompt length must actually MAP to this bucket (in
-        # (previous rung, bucket]) and leave room for 2 new tokens; a
-        # bucket with no such length (max_len within 2 of the previous
-        # rung) is unreachable by any decodable request, so skipping it
-        # keeps the readiness contract honest rather than violating it.
+        # (previous rung, bucket]). Prefer leaving room for 2 new
+        # tokens — a 1-token request finishes on its prefill-sampled
+        # token and would never touch (= compile) the batched decode
+        # step. But a bucket reachable ONLY by max_new_tokens=1
+        # requests (max_len within 2 of the previous rung) still gets
+        # its prefill/admit programs compiled via a 1-token warmup:
+        # the post-warmup freeze below makes EVERY admissible request
+        # shape's absence an outage, not a lazy compile. Only a bucket
+        # no admissible request can map to at all (no length in range
+        # even with one new token) is skipped — submit() can never
+        # send traffic there, so skipping keeps the readiness contract
+        # honest AND freeze-safe.
         length = min(bucket, engine.max_len - 2)
+        new_tokens = 2
         lo, prev_lo = bucket + 1, lo
         if length < prev_lo:
-            continue
+            length, new_tokens = min(bucket, engine.max_len - 1), 1
+            if length < prev_lo:
+                continue
         for k in rungs:
-            # max_new_tokens=2, not 1: a 1-token request finishes on its
-            # prefill-sampled token and would never touch (= compile)
-            # the batched decode step. k same-bucket submissions land as
-            # ONE admission wave, compiling the (k, bucket) prefill.
+            # k same-bucket submissions land as ONE admission wave,
+            # compiling the (k, bucket) prefill.
             for _ in range(k):
-                engine.submit([0] * length, 2)
+                engine.submit([0] * length, new_tokens)
             engine.drain()
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
           f"prefill program(s) ({args.warmup}), "
@@ -97,8 +107,17 @@ def main(argv: list[str] | None = None) -> None:
           f"{engine.max_len} ctx; prefill buckets "
           f"{engine.sched.buckets}; listening on "
           f"{args.host}:{args.port}", file=sys.stderr, flush=True)
+    # After a FULL warmup the compile set is complete by contract, so
+    # freeze the retrace budgets: a compile after /healthz went green
+    # is a shape leak eating a live request's latency, and the engine
+    # loop dying with CompileBudgetExceeded (failing queued requests
+    # with the reason) beats serving it silently. --warmup=buckets
+    # deliberately leaves lazy wave compiles, so no freeze there.
+    freeze = (engine.tracecheck.frozen() if args.warmup == "full"
+              else contextlib.nullcontext())
     try:
-        server.serve_forever()
+        with freeze:
+            server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:

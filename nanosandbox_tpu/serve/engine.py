@@ -64,6 +64,7 @@ import numpy as np
 
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
 from nanosandbox_tpu.utils.metrics import RingStat
+from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
 
 
 @dataclass(frozen=True)
@@ -173,24 +174,30 @@ class Engine:
         self._tpot = RingStat(1024)          # per-token seconds after first
         self._queue_wait = RingStat(1024)    # decode steps spent queued
         self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
-        # Trace-time side-effect counters: each retrace of a step
-        # function bumps these, so a shape leak (e.g. a Python scalar
-        # specializing a trace) shows up as a failing compile-budget
-        # assert instead of a silent 10x serving slowdown.
-        self.trace_counts = {"prefill": 0, "decode": 0,
-                             "admit": 0, "release": 0}
+        # Retrace budgets (utils.tracecheck): jax calls each guarded
+        # body once per TRACE, so a shape leak (e.g. a Python scalar
+        # specializing a trace) raises CompileBudgetExceeded at the
+        # retrace instead of becoming a silent 10x serving slowdown.
+        # Per-engine registry — tests spin up many engines.
+        self.tracecheck = TraceBudgetRegistry()
+        budget = self.max_programs()
 
         # CPU jit ignores donation (and warns); only donate pool/state on
         # accelerators, where reusing the buffers in place matters.
         on_accel = jax.default_backend() != "cpu"
+        guard = self.tracecheck.guard
         self._prefill = jax.jit(
-            self._prefill_fn, donate_argnums=(1,) if on_accel else ())
+            guard("prefill", budget["prefill"])(self._prefill_fn),
+            donate_argnums=(1,) if on_accel else ())
         self._decode = jax.jit(
-            self._decode_fn, donate_argnums=(1, 2) if on_accel else ())
+            guard("decode", budget["decode"])(self._decode_fn),
+            donate_argnums=(1, 2) if on_accel else ())
         self._admit = jax.jit(
-            self._admit_fn, donate_argnums=(0,) if on_accel else ())
+            guard("admit", budget["admit"])(self._admit_fn),
+            donate_argnums=(0,) if on_accel else ())
         self._release = jax.jit(
-            self._release_fn, donate_argnums=(0,) if on_accel else ())
+            guard("release", budget["release"])(self._release_fn),
+            donate_argnums=(0,) if on_accel else ())
 
     # ------------------------------------------------------------------
     # compiled step functions
@@ -211,7 +218,6 @@ class Engine:
         from nanosandbox_tpu.models.gpt import init_cache, scatter_cache_rows
         from nanosandbox_tpu.sample import _sample_token, row_keys
 
-        self.trace_counts["prefill"] += 1
         k, L = prompts.shape
         cache = init_cache(self.cfg, k, L)
         logits, cache = self.model.apply({"params": params}, prompts,
@@ -238,7 +244,6 @@ class Engine:
 
         from nanosandbox_tpu.sample import _sample_token, row_keys
 
-        self.trace_counts["decode"] += 1
         logits, pool = self.model.apply({"params": params},
                                         state["tok"][:, None],
                                         deterministic=True, cache=pool,
@@ -259,7 +264,6 @@ class Engine:
 
         One (k,)-shaped program per admit-ladder rung; padding rows carry
         the out-of-range slot id num_slots, dropped by the scatter."""
-        self.trace_counts["admit"] += 1
         return {
             "pos": state["pos"].at[slots].set(pos0, mode="drop"),
             "tok": state["tok"].at[slots].set(toks, mode="drop"),
@@ -272,7 +276,6 @@ class Engine:
 
     def _release_fn(self, state, slot):
         """Park one slot row back at the harmless idle values."""
-        self.trace_counts["release"] += 1
         return {
             "pos": state["pos"].at[slot].set(0),
             "tok": state["tok"].at[slot].set(0),
@@ -396,14 +399,22 @@ class Engine:
         }
 
     def max_programs(self) -> dict:
-        """The closed compile set by program kind — the compile-budget
-        contract the trace-counter asserts (tests, CI) check against."""
+        """The closed compile set by program kind — the budgets the
+        tracecheck guards enforce at runtime (a retrace past these
+        raises CompileBudgetExceeded) and tests/CI assert against."""
         return {
             "prefill": len(self.sched.buckets) * len(self.admit_buckets),
             "decode": 1,
             "admit": len(self.admit_buckets),
             "release": 1,
         }
+
+    @property
+    def trace_counts(self) -> Dict[str, int]:
+        """Observed traces per program kind, read from the tracecheck
+        registry (the engine no longer hand-counts; /stats, warmup
+        logging and the bench report all read this view)."""
+        return self.tracecheck.counts()
 
     # ------------------------------------------------------------------
     # internals
@@ -446,6 +457,7 @@ class Engine:
             # host copy below is for result lists and finish checks only.
             self._state = self._admit(self._state, slots_dev, true_lens,
                                       toks, temps, top_ks, top_ps, seeds)
+            # jaxlint: disable=host-sync -- first-token readback feeds results/eos checks
             toks_host = np.asarray(toks)
             now = time.monotonic()
             self._rate_ring.append((now, len(reqs)))
@@ -485,6 +497,7 @@ class Engine:
         belongs to nobody and is dropped (the host half of the one-step
         finish lag; the device active mask is the other half)."""
         toks, snapshot = inflight
+        # jaxlint: disable=host-sync -- the pipelined readback: one step behind dispatch
         nxt = np.asarray(toks)
         now = time.monotonic()
         n_live = 0

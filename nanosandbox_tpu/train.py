@@ -30,6 +30,7 @@ from typing import Any
 import numpy as np
 
 from nanosandbox_tpu.config import GPTConfig, TrainConfig, load_config
+from nanosandbox_tpu.utils import tracecheck
 
 # Peak bf16 FLOP/s per chip for MFU reporting (public spec-sheet numbers).
 _PEAK_FLOPS = {
@@ -293,6 +294,12 @@ class Trainer:
 
         self._train_step = None
         self._eval_step = None
+        # Retrace budgets for the compiled steps (utils.tracecheck):
+        # each is ONE program — batch/sequence shapes are fixed by the
+        # config — so a second trace means something specialized the
+        # step (the failure mode jaxlint's nonstatic-shape rule hunts
+        # statically) and raises instead of silently recompiling.
+        self.tracecheck = tracecheck.TraceBudgetRegistry()
 
     # -- state ---------------------------------------------------------------
 
@@ -442,17 +449,30 @@ class Trainer:
         if self._train_step is None:
             step = partial(self._train_step_fn)
             if self.cfg.compile:
+                # CPU jit ignores donation (and warns every compile);
+                # donate the train state only on accelerators, the same
+                # gate the serve engine applies to its pool/state.
+                on_accel = jax.default_backend() != "cpu"
+                # Budget 2 under --memory_report: its AOT .lower() on
+                # abstract operands traces once on top of the live step.
+                train_budget = 2 if self.cfg.memory_report else 1
+                step = self.tracecheck.guard("train_step",
+                                             train_budget)(step)
+                eval_fn = self.tracecheck.guard("eval_step",
+                                                1)(self._eval_step_fn)
                 self._train_step = jax.jit(
                     step,
                     in_shardings=(self.state_shardings, self.batch_sharding,
                                   self.batch_sharding, None),
                     out_shardings=(self.state_shardings, None),
-                    donate_argnums=(0,))
+                    donate_argnums=(0,) if on_accel else ())
                 self._eval_step = jax.jit(
-                    self._eval_step_fn,
+                    eval_fn,
                     in_shardings=(self.state_shardings, self.batch_sharding,
                                   self.batch_sharding))
             else:
+                # Uncompiled steps run the body EVERY call — a call
+                # counter would not be a trace counter, so no guard.
                 self._train_step = step
                 self._eval_step = self._eval_step_fn
         return self._train_step, self._eval_step
@@ -547,7 +567,11 @@ class Trainer:
             ]
             losses = [eval_step(state, self.to_global(xb), self.to_global(yb))
                       for xb, yb in batches]
-            out[split] = float(jnp.stack(losses).mean())
+            # tracecheck.host_sync is THE deliberate readback: the one
+            # scalar sync per split the comment above promises, logged
+            # so profiler windows can report their sync count.
+            out[split] = tracecheck.host_sync("eval-readback",
+                                              jnp.stack(losses).mean())
         return out
 
     # -- MFU -----------------------------------------------------------------
@@ -598,6 +622,7 @@ class Trainer:
                          else "scratch")
         if init_from == "resume":
             state, extra = ckpt.restore(self.abstract_state)
+            # jaxlint: disable=host-sync -- one-time resume readback
             iter_num = int(extra.get("iter_num", int(state["step"])))
             best_val_loss = float(extra.get("best_val_loss", 1e9))
             if self.is_main:
@@ -702,6 +727,10 @@ class Trainer:
                 if prof_range and iter_num == prof_range[0]:
                     jax.profiler.start_trace(self.profile_dir)
                     self._profiling = True
+                    # Snapshot the sync ledger so the window report
+                    # below describes the TRACED REGION's syncs, not the
+                    # process-lifetime totals.
+                    self._profile_sync_mark = tracecheck.sync_counts()
 
                 xb, yb = next(loader)
                 step_rng = jax.random.fold_in(rng, iter_num)
@@ -714,16 +743,31 @@ class Trainer:
                     # block_until_ready: some PJRT transports make the
                     # latter a no-op (see utils/benchmarking.py), which
                     # would stop the trace before the device work lands.
-                    float(metrics["loss"])
+                    # host_sync (not a bare float()) so the drain lands
+                    # in the sync ledger with the rest of the window.
+                    tracecheck.host_sync("profile-window-drain",
+                                         metrics["loss"])
                     jax.profiler.stop_trace()
                     self._profiling = False
                     if self.is_main:
+                        mark = self._profile_sync_mark
+                        by_kind = {
+                            k: v - mark.get(k, 0)
+                            for k, v in tracecheck.sync_counts().items()
+                            if v - mark.get(k, 0) > 0
+                        }
                         print(f"profiler trace for iters "
                               f"[{prof_range[0]}:{prof_range[1]}) -> "
-                              f"{self.profile_dir}")
+                              f"{self.profile_dir} "
+                              f"({sum(by_kind.values())} logged host "
+                              f"sync(s) in the window; by kind: "
+                              f"{by_kind})")
 
                 if cfg.log_interval > 0 and iter_num % cfg.log_interval == 0:
-                    loss = float(metrics["loss"])  # sync point
+                    # The log-step sync point, through the audited
+                    # readback wrapper (profiler windows count it).
+                    loss = tracecheck.host_sync("train-log-readback",
+                                                metrics["loss"])
                     last_loss = loss
                     # Window-averaged timing: under async dispatch the
                     # host enqueues steps far faster than the device runs
@@ -745,6 +789,7 @@ class Trainer:
                               f"tok/s {toks:,.0f}, mfu {mfu * 100:.2f}%")
                     writer.log(iter_num, {
                         "train/loss": loss,
+                        # jaxlint: disable=host-sync -- free after loss sync
                         "train/grad_norm": float(metrics["grad_norm"]),
                         "train/lr": float(self.lr_schedule(iter_num))
                         if callable(self.lr_schedule) else self.lr_schedule,

@@ -35,6 +35,7 @@ def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
             xb, yb = next(loader)
             state, m = train_step(state, trainer.to_global(xb),
                                   trainer.to_global(yb), rng)
+        # jaxlint: disable=host-sync -- the warmup fence the timing needs
         float(m["loss"])  # hard sync: some PJRT transports make
         # block_until_ready a no-op; a scalar readback always waits.
 
@@ -43,6 +44,7 @@ def measure_train_throughput(cfg, warmup: int, iters: int) -> dict:
             xb, yb = next(loader)
             state, m = train_step(state, trainer.to_global(xb),
                                   trainer.to_global(yb), rng)
+        # jaxlint: disable=host-sync -- the stop-the-clock drain being measured
         loss = float(m["loss"])
         step_s = (time.perf_counter() - t0) / iters
     finally:

@@ -33,8 +33,11 @@ from nanosandbox_tpu.serve import (Engine, SlotScheduler, admit_ladder,
 
 
 def _assert_compile_budget(eng):
-    """The closed-compile-set contract: every trace counter within the
-    engine's published per-kind budget (admit/release included)."""
+    """The closed-compile-set contract, enforced two ways: the runtime
+    guard's own postcondition (utils.tracecheck — a retrace past budget
+    would already have raised), and the published per-kind numbers."""
+    eng.tracecheck.assert_within_budget()
+    assert eng.tracecheck.budgets() == eng.max_programs()
     budget = eng.max_programs()
     for kind, count in eng.trace_counts.items():
         assert count <= budget[kind], (kind, count, budget)
@@ -305,6 +308,55 @@ def test_stats_latency_fields(served_model):
         assert 0 <= pct["p50"] <= pct["p99"]
     assert s["pipeline"] is True
     assert s["admit_buckets"] == [1, 2]
+
+
+def test_deliberate_extra_retrace_raises(served_model):
+    """ISSUE 3 acceptance: the compile budget is ENFORCED, not just
+    counted — feeding the compiled decode step operands of a new shape
+    (the classic leak: a pool/state sized off a runtime value instead
+    of num_slots) retraces past the budget of 1 and raises, instead of
+    silently compiling a second program per distinct shape."""
+    from nanosandbox_tpu.models.gpt import init_cache
+    from nanosandbox_tpu.utils.tracecheck import CompileBudgetExceeded
+
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    rid = eng.submit([1, 2, 3], 4)
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res[rid].tokens) == 4
+    assert eng.trace_counts["decode"] == 1
+
+    shrunken_pool = init_cache(cfg, 1, eng.max_len)
+    shrunken_state = {k: v[:1] for k, v in eng._state.items()}
+    with pytest.raises(CompileBudgetExceeded, match="'decode'"):
+        eng._decode(eng.params, shrunken_pool, shrunken_state)
+    # The rejected trace compiled nothing and consumed no counter —
+    # trace_counts keeps describing the REAL compile set.
+    assert eng.trace_counts["decode"] == 1
+    eng.tracecheck.assert_within_budget()
+    # The healthy programs keep serving: the budget names the leaky
+    # program instead of poisoning the engine.
+    rid2 = eng.submit([4, 5], 3)
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res[rid2].tokens) == 3
+
+
+def test_frozen_registry_turns_lazy_compiles_into_errors(served_model):
+    """The serve __main__ post-warmup contract: after --warmup=full the
+    registry freezes, so a request shape that somehow escaped warmup
+    fails loudly instead of eating a cold compile mid-traffic."""
+    from nanosandbox_tpu.utils.tracecheck import CompileBudgetExceeded
+
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=1, max_len=64)
+    eng.submit([1, 2, 3], 2)
+    eng.drain()                      # bucket-16 single-wave set compiled
+    with eng.tracecheck.frozen():
+        eng.submit([1, 2], 2)        # same (1, 16) programs: cached, fine
+        eng.drain()
+        eng.submit([9] * 20, 2)      # bucket 32: would need a NEW compile
+        with pytest.raises(CompileBudgetExceeded, match="frozen"):
+            eng.drain()
 
 
 def test_sampled_output_independent_of_batch_composition(served_model):

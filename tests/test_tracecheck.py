@@ -1,0 +1,115 @@
+"""utils.tracecheck tests: retrace budgets, freezing, the sync ledger.
+
+The guard's whole premise is that jax calls a wrapped Python body once
+per TRACE, so counting calls counts compiles — pinned here against a
+real jax.jit (same shape twice -> one bump; new shape -> retrace ->
+bump -> overflow raises).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nanosandbox_tpu.utils import tracecheck
+from nanosandbox_tpu.utils.tracecheck import (CompileBudgetExceeded,
+                                              TraceBudgetRegistry,
+                                              compile_budget)
+
+
+def test_guard_counts_calls_and_raises_on_overflow():
+    reg = TraceBudgetRegistry()
+
+    @reg.guard("step", 2)
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2 and f(2) == 3
+    assert reg.counts() == {"step": 2}
+    with pytest.raises(CompileBudgetExceeded, match="'step' would trace 3"):
+        f(3)
+    # The rejected trace compiled nothing, so it consumed no counter:
+    # counts() keeps describing the REAL compile set and the budget
+    # postcondition stays healthy on an engine that survived the leak.
+    assert reg.counts() == {"step": 2}
+    reg.assert_within_budget()
+    # The message points at the static-analysis companion.
+    with pytest.raises(CompileBudgetExceeded, match="nanosandbox_tpu"):
+        f(4)
+
+
+def test_budget_zero_rejects_first_trace_and_negative_rejected():
+    reg = TraceBudgetRegistry()
+    with pytest.raises(ValueError, match="max_traces"):
+        reg.guard("x", -1)
+
+    @reg.guard("never", 0)
+    def f():
+        return None
+
+    with pytest.raises(CompileBudgetExceeded):
+        f()
+
+
+def test_under_jit_counts_traces_not_calls():
+    reg = TraceBudgetRegistry()
+    f = jax.jit(reg.guard("decode", 1)(lambda x: x * 2))
+    x = jnp.ones((4,))
+    for _ in range(5):                      # one shape: one trace
+        f(x)
+    assert reg.counts() == {"decode": 1}
+    with pytest.raises(CompileBudgetExceeded, match="'decode'"):
+        f(jnp.ones((8,)))                   # shape leak: retrace
+
+
+def test_frozen_context_rejects_any_new_trace():
+    reg = TraceBudgetRegistry()
+    f = jax.jit(reg.guard("step", 2)(lambda x: x + 1))
+    f(jnp.ones((2,)))
+    with reg.frozen():
+        f(jnp.ones((2,)))                  # cached program: no trace, fine
+        with pytest.raises(CompileBudgetExceeded, match="frozen"):
+            f(jnp.ones((3,)))
+    # The frozen rejection consumed NO budget (the trace was aborted
+    # before compiling): with budget 2 the post-unfreeze compile fits.
+    assert reg.counts()["step"] == 1
+    f(jnp.ones((4,)))                      # unfrozen again: budget applies
+    assert reg.counts()["step"] == 2
+
+
+def test_assert_within_budget_reports_every_overflow():
+    reg = TraceBudgetRegistry()
+    reg.register("a", 1)
+    reg.assert_within_budget()
+    reg.bump("a")
+    reg.assert_within_budget()
+    with pytest.raises(CompileBudgetExceeded):
+        reg.bump("a")
+    reg.assert_within_budget()         # rejected bump consumed nothing
+    # Tightening a budget BELOW the already-observed traces is the one
+    # way counts can exceed it — the postcondition names the offender.
+    reg.register("a", 0)
+    with pytest.raises(CompileBudgetExceeded, match="'a'"):
+        reg.assert_within_budget()
+    assert reg.budgets() == {"a": 0}
+
+
+def test_compile_budget_decorator_uses_global_registry_by_default():
+    name = "test-global-budget-unique"
+
+    @compile_budget(name, 1)
+    def f():
+        return 7
+
+    assert f() == 7
+    assert tracecheck.global_registry().counts()[name] == 1
+
+
+def test_host_sync_reads_scalar_and_counts():
+    before = tracecheck.sync_count("test-window")
+    total_before = tracecheck.sync_count()
+    val = tracecheck.host_sync("test-window", jnp.float32(2.5))
+    assert isinstance(val, float) and val == 2.5
+    assert tracecheck.host_sync("test-window") is None   # count-only form
+    assert tracecheck.sync_count("test-window") == before + 2
+    assert tracecheck.sync_count() == total_before + 2
+    assert tracecheck.sync_counts()["test-window"] >= 2
