@@ -14,10 +14,14 @@ per-device hardware).
 
 Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
        python bench.py --mode=decode [--quick] [--num_slots=N] \
-           [--max_new_tokens=N] [--requests=N] [--mixed=1]
+           [--max_new_tokens=N] [--requests=N] [--mixed=1] \
+           [--spec={off,ngram}] [--spec_k=N] [--repetitive] [--repeat=N]
 
 Decode mode reports pipelined AND synchronous tokens/sec (plus TTFT
 percentiles) so the pipelining win is trend-tracked in CI, no threshold.
+Engine comparisons run --repeat interleaved rounds (3 by default off
+--quick) and report per-engine MEDIANS, so a contended host can't turn
+a single slow drain into a bogus ratio.
 """
 
 from __future__ import annotations
@@ -118,7 +122,13 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     Knobs: --num_slots (alias --slots), --max_new_tokens, --requests,
     --mixed (vary max_new_tokens per request so finishes stagger and
     mid-run backfill/eviction dominate — the continuous-batching regime,
-    and the acceptance workload for the pipelining PR).
+    and the acceptance workload for the pipelining PR), --spec={off,
+    ngram} (+ --spec_k=N) to ALSO run the same workload through the
+    speculative-decoding engine (serve/spec.py) and report acceptance
+    rate, mean accepted draft length and the spec-vs-baseline tokens/sec
+    ratio, --repetitive (prompts built from a short repeated motif — the
+    prompt-lookup drafter's favorable regime, and the workload the
+    speculative acceptance bar is measured on).
     """
     import time
 
@@ -129,7 +139,7 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     from nanosandbox_tpu.config import GPTConfig
     from nanosandbox_tpu.models.gpt import GPT
     from nanosandbox_tpu.sample import cast_params_for_serving
-    from nanosandbox_tpu.serve import Engine
+    from nanosandbox_tpu.serve import Engine, NGramDrafter
 
     if on_tpu:  # GPT-2 124M, the train bench's model, in serving dtype
         cfg = GPTConfig(n_layer=12, n_head=12, n_embd=768, block_size=1024,
@@ -146,6 +156,14 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     max_new = int(kv.get("max_new_tokens", max_new))
     n_requests = int(kv.get("requests", 2 * num_slots))
     mixed = "mixed" in kv and kv["mixed"] not in ("0", "false", "no")
+    spec = kv.get("spec", "off")
+    if spec not in ("off", "ngram"):
+        # ModelDrafter needs a restored checkpoint; the bench initializes
+        # random weights, so only the weight-free drafter is benchable.
+        raise SystemExit(f"--spec={spec!r}: decode bench supports off|ngram")
+    spec_k = int(kv.get("spec_k", 4))
+    repetitive = ("repetitive" in kv
+                  and kv["repetitive"] not in ("0", "false", "no"))
 
     model = GPT(cfg)
     params = model.init(jax.random.key(0),
@@ -154,18 +172,26 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
 
     def workload(engine, n, seed):
         """Mixed prompt lengths (drawn per request, same stream for both
-        engines); --mixed also staggers the token budgets."""
+        engines); --mixed also staggers the token budgets; --repetitive
+        tiles a short per-request motif instead of sampling tokens
+        independently (the regime where prompt-lookup drafting hits)."""
         rng = np.random.default_rng(seed)
         for _ in range(n):
             L = int(rng.integers(1, max(2, max_len - max_new)))
             mnt = (int(rng.integers(max(1, max_new // 4), max_new + 1))
                    if mixed else max_new)
-            prompt = rng.integers(0, cfg.vocab_size, max(L, 1)).tolist()
+            if repetitive:
+                motif = rng.integers(0, cfg.vocab_size,
+                                     int(rng.integers(2, 5)))
+                prompt = np.tile(motif, max(L, 1) // len(motif) + 1)[
+                    :max(L, 1)].tolist()
+            else:
+                prompt = rng.integers(0, cfg.vocab_size, max(L, 1)).tolist()
             engine.submit(prompt, mnt)
 
-    def run(pipeline: bool):
+    def build(pipeline: bool, drafter=None):
         engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
-                        pipeline=pipeline)
+                        pipeline=pipeline, spec=drafter)
         # Warmup: every (wave rung, bucket) prefill + admit + decode +
         # release program, so no timed window eats an XLA compile. The
         # prompt length must MAP to the bucket being warmed (in
@@ -185,21 +211,65 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         # rings (45 warmup requests vs 16 timed at the defaults): the
         # reported percentiles must describe the measured traffic.
         engine.reset_latency_stats()
-        workload(engine, n_requests, seed=0)
+        return engine
+
+    def timed(engine, seed: int):
+        workload(engine, n_requests, seed=seed)
         t0 = time.perf_counter()
         results = engine.drain()
         dt = time.perf_counter() - t0
-        generated = sum(len(r.tokens) for r in results)
-        return engine, generated, dt
+        return sum(len(r.tokens) for r in results), dt
 
-    _, sync_generated, sync_dt = run(pipeline=False)
-    engine, generated, dt = run(pipeline=True)
+    # INTERLEAVED repeats, median rate per engine (--repeat=N; 3 by
+    # default off --quick): a shared/contended host can swing a single
+    # 50ms drain several-fold, so engine comparisons alternate rounds
+    # (same per-round workload seed for every engine) and report the
+    # median — the PR 2 measurement discipline, now built in.
+    repeat = int(kv.get("repeat", 1 if quick else 3))
+    engines = {"sync": build(pipeline=False), "pipe": build(pipeline=True)}
+    if spec != "off":
+        engines["spec"] = build(pipeline=True,
+                                drafter=NGramDrafter(k=spec_k))
+    rates = {name: [] for name in engines}
+    gen_total = {name: 0 for name in engines}
+    dt_total = {name: 0.0 for name in engines}
+    for r in range(repeat):
+        for name, eng in engines.items():
+            g, d = timed(eng, seed=r)
+            rates[name].append(g / d)
+            gen_total[name] += g
+            dt_total[name] += d
+
+    from statistics import median
+
+    engine = engines["pipe"]
     stats = engine.stats()
+    rate = median(rates["pipe"])
+    generated, dt = gen_total["pipe"], dt_total["pipe"]
 
+    spec_extra = {"spec": spec}
+    if spec != "off":
+        # SAME per-round workload seeds through the speculative engine;
+        # greedy parity with the baseline engines is pinned by
+        # tests/test_spec.py, so the bench only times it. The comparison
+        # baseline is the pipelined engine (the PR 3 configuration).
+        sstats = engines["spec"].stats()
+        spec_rate = median(rates["spec"])
+        spec_extra.update({
+            "spec_k": spec_k,
+            "spec_tokens_per_sec": spec_rate,
+            "spec_vs_baseline": spec_rate / rate,
+            "acceptance_rate": sstats["spec_acceptance_rate"],
+            "mean_accepted_len": sstats["spec_accepted_len_mean"],
+            "spec_verify_steps": sstats["spec"]["verify_steps"],
+            "spec_tokens_generated": gen_total["spec"],
+        })
+
+    sync_rate = median(rates["sync"])
     return {
         "metric": "gpt2_124m_batched_decode_tokens_per_sec" if on_tpu
         else "tiny_batched_decode_tokens_per_sec_cpu",
-        "value": generated / dt,
+        "value": rate,
         "unit": "tokens/sec",
         "vs_baseline": None,  # no published serving baseline (BASELINE.json)
         "extra": {
@@ -209,18 +279,23 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "max_new_tokens": max_new,
             "requests": n_requests,
             "mixed": mixed,
+            "repeat": repeat,
             "tokens_generated": generated,
             "decode_steps": engine.steps,
             "prefill_buckets": list(engine.sched.buckets),
             "admit_buckets": list(engine.admit_buckets),
             "trace_counts": dict(engine.trace_counts),
             "elapsed_s": dt,
-            "pipelined_tokens_per_sec": generated / dt,
-            "sync_tokens_per_sec": sync_generated / sync_dt,
-            "pipeline_speedup": (generated / dt) / (sync_generated / sync_dt),
+            "pipelined_tokens_per_sec": rate,
+            "sync_tokens_per_sec": sync_rate,
+            "pipeline_speedup": rate / sync_rate,
+            "rates_per_round": {name: [round(r, 1) for r in rs]
+                                for name, rs in rates.items()},
             "ttft_s": stats["ttft_s"],
             "tpot_s": stats["tpot_s"],
             "queue_wait_steps_mean": stats["queue_wait_steps_mean"],
+            "repetitive": repetitive,
+            **spec_extra,
         },
     }
 
@@ -230,6 +305,8 @@ def main(argv: list[str]) -> dict:
     kv = dict(a.lstrip("-").split("=", 1) for a in argv if "=" in a)
     if "--mixed" in argv:  # bare flag form, like --quick
         kv.setdefault("mixed", "1")
+    if "--repetitive" in argv:
+        kv.setdefault("repetitive", "1")
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -83,11 +83,25 @@ class CausalSelfAttention(nn.Module):
                 # Per-row frontiers (serve engine's slot pool): each batch
                 # row b writes its K/V at its OWN position cache_index[b]
                 # and attends up to it. vmap over the batch dim turns the
-                # single dynamic_update_slice into one write per row —
-                # the shapes stay fixed, so one compiled decode step
-                # serves every mix of in-flight request lengths.
-                def _row_write(buf, x, i):
-                    return lax.dynamic_update_slice(buf, x, (0, i, 0))
+                # single write into one write per row — the shapes stay
+                # fixed, so one compiled decode step serves every mix of
+                # in-flight request lengths.
+                if T == 1:
+                    # Decode hot path: a 1-column dynamic_update_slice per
+                    # row, unchanged from the pre-speculative engine.
+                    def _row_write(buf, x, i):
+                        return lax.dynamic_update_slice(buf, x, (0, i, 0))
+                else:
+                    # Speculative-verify path: a fixed (T = k+1)-column
+                    # block per row. Scatter with mode='drop', NOT
+                    # dynamic_update_slice — for a row whose frontier sits
+                    # within T of the buffer end, the slice CLAMP would
+                    # shift the whole write backwards and overwrite valid
+                    # history; drop discards only the out-of-range
+                    # columns (masked off by position anyway).
+                    def _row_write(buf, x, i):
+                        cols = i + jnp.arange(T)
+                        return buf.at[:, cols, :].set(x, mode="drop")
                 ck = jax.vmap(_row_write)(ck, k.astype(ck.dtype), cache_index)
                 cv = jax.vmap(_row_write)(cv, v.astype(cv.dtype), cache_index)
                 qpos = cache_index[:, None] + jnp.arange(T)[None, :]  # (B, T)
@@ -489,12 +503,18 @@ def _chunked_nll_sums(hidden, embedding, targets, *, chunk_size: int,
         tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
         nll = lse - tgt
         tot, cnt = carry
-        return (tot + jnp.where(valid, nll, 0.0).sum(),
-                cnt + valid.sum()), None
+        return (tot + jnp.where(valid, nll, 0.0).sum()[None],
+                cnt + valid.sum()[None]), None
 
+    # Shape-(1,) carries, not scalars: under the sequence-parallel
+    # shard_map wrapper below, jax 0.4.x cannot transpose a scan whose
+    # residuals are rank-0 (the scalar-residual promotion that fixes
+    # this landed after 0.4.37, _SpecError from grad-of-shard_map), and
+    # a trailing squeeze is free either way.
     (tot, cnt), _ = lax.scan(
-        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (h, y))
-    return tot, cnt
+        body, (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32)),
+        (h, y))
+    return tot[0], cnt[0]
 
 
 def sharded_chunked_cross_entropy_loss(hidden: jax.Array,
@@ -528,9 +548,11 @@ def sharded_chunked_cross_entropy_loss(hidden: jax.Array,
         cnt = lax.psum(cnt, ("data", "fsdp", "seq"))
         return tot / jnp.maximum(cnt, 1)
 
-    fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(hspec, P(None, None), yspec),
-                       out_specs=P(), check_vma=False)
+    from nanosandbox_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(hspec, P(None, None), yspec),
+                   out_specs=P(), check_vma=False)
     return fn(hidden, embedding, targets)
 
 

@@ -369,9 +369,11 @@ def _sharded_fn(mesh, causal: bool, sm_scale: float, seq_axis: str,
         body = functools.partial(zigzag_ring_attention, **common)
     else:
         body = functools.partial(ring_attention, causal=causal, **common)
-    return jax.shard_map(body, mesh=mesh,
-                         in_specs=(spec, spec, spec, P(None)),
-                         out_specs=spec, check_vma=False)
+    from nanosandbox_tpu.parallel.mesh import shard_map
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=(spec, spec, spec, P(None)),
+                     out_specs=spec, check_vma=False)
 
 
 def clear_sharded_cache() -> None:

@@ -71,55 +71,88 @@ def _sample_token(logits_i, rng, *, temperature: float, top_k: int,
     return jax.random.categorical(sub, logits_i).astype(jnp.int32), rng
 
 
-def _sample_token_rows(logits_i, rng, *, temperature, top_k, top_p):
-    """Vectorized per-row variant of _sample_token: every parameter is
-    broadcast to (B,) and each row is filtered/sampled under its own
-    settings. Rows with temperature == 0 take argmax of the RAW logits
-    (identical to the scalar greedy contract, and independent of the
-    other rows' parameters). Branches become masks — one compiled shape
-    serves every parameter mix, which is what bounds the serve engine's
-    compile count.
+def _filter_logits_rows(logits_i, *, temperature, top_k, top_p):
+    """Per-row temperature/top-k/nucleus filtering of (B, V) float32
+    logits: returns categorical-ready logits (filtered entries -1e30).
+    Shared by _sample_token_rows and the speculative-verify path
+    (serve/spec.py) — the verify step must score draft tokens against
+    EXACTLY the distribution the decode step samples from, or rejection
+    sampling stops preserving the output distribution, so the filter
+    lives in one function both compile.
+
+    Rows with temperature <= 0 are scaled by 1 (the caller takes argmax
+    of the RAW logits for those, the scalar greedy contract).
 
     Costs one full-vocab argsort per call — the descending permutation
     is shared by the per-row kth threshold (lax.top_k needs a static k;
-    per-row k does not have one) and the nucleus cumsum. Fine at test
-    vocabs; at GPT-2's 50k vocab it is the first thing to optimize if
-    decode-step profiles say so."""
+    per-row k does not have one) and the nucleus cumsum. The sort only
+    RUNS when some row actually filters (lax.cond below): greedy rows
+    never consume the filtered logits (their callers take raw argmax),
+    and t>0 rows with top-k/top-p disabled get identity filtering, so
+    an all-greedy/unfiltered batch — the serving common case, and every
+    speculative-verify step of a greedy workload — skips the whole sort
+    at runtime while staying ONE compiled program."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     B, V = logits_i.shape
     t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
     k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
     p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
 
-    greedy = jnp.argmax(logits_i, axis=-1).astype(jnp.int32)
     x = logits_i / jnp.where(t > 0, t, 1.0)[:, None]
 
-    # ONE shared descending permutation serves both filters (the
-    # full-vocab sort is this path's hot cost — see docstring). Top-k
-    # only demotes entries already below the kth threshold to -1e30, so
-    # the pre-filter order still sorts the post-filter array for the
-    # nucleus cumsum.
-    sort_idx = jnp.argsort(-x, axis=-1)
+    def _full(x):
+        # ONE shared descending permutation serves both filters (the
+        # full-vocab sort is this path's hot cost — see docstring).
+        # Top-k only demotes entries already below the kth threshold to
+        # -1e30, so the pre-filter order still sorts the post-filter
+        # array for the nucleus cumsum.
+        sort_idx = jnp.argsort(-x, axis=-1)
 
-    # Per-row top-k: the kth-largest value is the keep threshold; rows
-    # with k <= 0 (disabled) skip the filter via the mask.
-    srt = jnp.take_along_axis(x, sort_idx, axis=-1)
-    kth = jnp.take_along_axis(srt, (jnp.clip(k, 1, V) - 1)[:, None], axis=-1)
-    x = jnp.where((k[:, None] > 0) & (x < kth), -1e30, x)
+        # Per-row top-k: the kth-largest value is the keep threshold;
+        # rows with k <= 0 (disabled) skip the filter via the mask.
+        srt = jnp.take_along_axis(x, sort_idx, axis=-1)
+        kth = jnp.take_along_axis(srt, (jnp.clip(k, 1, V) - 1)[:, None],
+                                  axis=-1)
+        x = jnp.where((k[:, None] > 0) & (x < kth), -1e30, x)
 
-    # Per-row nucleus: same construction as the scalar path with p
-    # broadcast per row; p >= 1 rows keep everything exactly (no
-    # reliance on cumsum rounding), p <= 0 rows degrade to top-1.
-    sorted_logits = jnp.take_along_axis(x, sort_idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    mass_before = jnp.cumsum(probs, axis=-1) - probs
-    keep_sorted = ((mass_before < p[:, None]) |
-                   (p[:, None] >= 1.0)).at[:, 0].set(True)
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(B)[:, None], sort_idx].set(keep_sorted)
-    x = jnp.where(keep, x, -1e30)
+        # Per-row nucleus: same construction as the scalar path with p
+        # broadcast per row; p >= 1 rows keep everything exactly (no
+        # reliance on cumsum rounding), p <= 0 rows degrade to top-1.
+        sorted_logits = jnp.take_along_axis(x, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = ((mass_before < p[:, None]) |
+                       (p[:, None] >= 1.0)).at[:, 0].set(True)
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(B)[:, None], sort_idx].set(keep_sorted)
+        return jnp.where(keep, x, -1e30)
+
+    # A row filters only when it both samples (t > 0; greedy rows take
+    # raw argmax and never read this output) and truncates (k > 0 or
+    # p < 1; otherwise the filter is identity on the scaled logits).
+    need = jnp.any((t > 0.0) & ((k > 0) | (p < 1.0)))
+    return lax.cond(need, _full, lambda x: x, x)
+
+
+def _sample_token_rows(logits_i, rng, *, temperature, top_k, top_p):
+    """Vectorized per-row variant of _sample_token: every parameter is
+    broadcast to (B,) and each row is filtered/sampled under its own
+    settings (via _filter_logits_rows above). Rows with temperature == 0
+    take argmax of the RAW logits (identical to the scalar greedy
+    contract, and independent of the other rows' parameters). Branches
+    become masks — one compiled shape serves every parameter mix, which
+    is what bounds the serve engine's compile count."""
+    import jax
+    import jax.numpy as jnp
+
+    B = logits_i.shape[0]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    greedy = jnp.argmax(logits_i, axis=-1).astype(jnp.int32)
+    x = _filter_logits_rows(logits_i, temperature=temperature,
+                            top_k=top_k, top_p=top_p)
 
     # jaxlint: disable=tracer-leak -- _is_key_batch reads dtype/ndim only (static)
     if _is_key_batch(rng):
@@ -277,6 +310,17 @@ def main(argv: list[str] | None = None) -> list[str]:
     ap.add_argument("--top_p", type=float, default=1.0,
                     help="nucleus sampling mass (1.0 disables)")
     ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--spec", default="off",
+                    help="speculative decoding: 'ngram' (prompt-lookup "
+                         "drafting, zero extra weights) or "
+                         "'model:<out_dir>' (a smaller same-tokenizer "
+                         "draft checkpoint); routes generation through "
+                         "the serve engine's batched verify step — "
+                         "greedy outputs identical, sampled outputs "
+                         "identically distributed (per-sample seeds "
+                         "seed+i instead of one shared stream)")
+    ap.add_argument("--spec_k", type=int, default=4,
+                    help="draft tokens per verify step (--spec only)")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
     if args.num_samples < 1:
         # Validate BEFORE the checkpoint restore below: a bad flag should
@@ -303,6 +347,44 @@ def main(argv: list[str] | None = None) -> list[str]:
     meta = ds.meta
     tok = get_tokenizer(meta.get("kind", "char"), meta)
     start_ids = tok.encode(start_text) or [0]
+
+    if args.spec != "off":
+        # Speculative path: generation runs through the serve engine's
+        # batched verify step (serve/spec.py) — the drafter guesses k
+        # tokens and one target forward scores them all. Bounded to the
+        # cached-decode regime: the windowed fallback has no KV frontier
+        # to verify against.
+        from nanosandbox_tpu.serve import Engine
+        from nanosandbox_tpu.serve.drafters import drafter_from_flag
+
+        total = len(start_ids) + args.max_new_tokens
+        if total > cfg.block_size:
+            ap.error(f"--spec needs prompt + max_new_tokens <= block_size "
+                     f"({total} > {cfg.block_size}); drop --spec to use "
+                     "the windowed fallback")
+        drafter = drafter_from_flag(args.spec, k=args.spec_k,
+                                    data_dir=args.data_dir)
+        engine = Engine(trainer.model, params,
+                        num_slots=min(args.num_samples, 8),
+                        max_len=cfg.block_size, spec=drafter)
+        rids = [engine.submit(start_ids, args.max_new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p,
+                              seed=args.seed + i)
+                for i in range(args.num_samples)]
+        res = {r.rid: r for r in engine.drain()}
+        texts = []
+        for rid in rids:
+            text = tok.decode(list(res[rid].prompt) + res[rid].tokens)
+            texts.append(text)
+            print(text)
+            print("---------------")
+        s = engine.stats()
+        print(f"[spec] drafter={s['spec']['drafter']} k={s['spec']['k']} "
+              f"acceptance_rate={s['spec_acceptance_rate']} "
+              f"accepted_len_mean={s['spec_accepted_len_mean']}",
+              file=sys.stderr)
+        return texts
 
     idx = jnp.asarray([start_ids] * args.num_samples, jnp.int32)
     rng = jax.random.key(args.seed)

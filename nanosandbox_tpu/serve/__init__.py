@@ -17,13 +17,22 @@ Pieces:
                  state, submit()/step()/drain().
   http.py      — EngineLoop (background stepping thread) + a stdlib
                  ThreadingHTTPServer frontend.
+  drafters.py  — speculative draft proposers: NGramDrafter (host-side
+                 prompt lookup, zero extra weights) and ModelDrafter (a
+                 small same-tokenizer GPT with its own slot-pool cache).
+  spec.py      — SpecRunner: the fixed-shape batched verification step
+                 (k+1 positions per slot, one program) + rejection
+                 sampling with per-row accepted lengths.
   __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
                  checkpoint and serve it.
 """
 
+from nanosandbox_tpu.serve.drafters import (ModelDrafter, NGramDrafter,
+                                            drafter_from_flag)
 from nanosandbox_tpu.serve.engine import Engine, Request, Result
 from nanosandbox_tpu.serve.scheduler import (SlotScheduler, admit_ladder,
                                              default_buckets)
 
 __all__ = ["Engine", "Request", "Result", "SlotScheduler",
-           "admit_ladder", "default_buckets"]
+           "admit_ladder", "default_buckets", "NGramDrafter",
+           "ModelDrafter", "drafter_from_flag"]

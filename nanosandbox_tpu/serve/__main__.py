@@ -31,6 +31,15 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_pipeline", action="store_true",
                     help="synchronous decode loop (debugging baseline); "
                          "default keeps one decode step in flight")
+    ap.add_argument("--spec", default="off",
+                    help="speculative decoding: 'ngram' (prompt-lookup "
+                         "drafting) or 'model:<out_dir>' (smaller "
+                         "same-tokenizer draft checkpoint); up to "
+                         "spec_k+1 tokens per target forward, greedy "
+                         "outputs unchanged (forces the synchronous "
+                         "loop)")
+    ap.add_argument("--spec_k", type=int, default=4,
+                    help="draft tokens per verify step (--spec only)")
     ap.add_argument("--warmup", choices=("full", "buckets"), default="full",
                     help="'full' compiles every (wave-size, bucket) "
                          "prefill pair before binding the port (the "
@@ -54,9 +63,13 @@ def main(argv: list[str] | None = None) -> None:
     ds = BinDataset(args.data_dir, args.dataset)
     tok = get_tokenizer(ds.meta.get("kind", "char"), ds.meta)
 
+    from nanosandbox_tpu.serve.drafters import drafter_from_flag
+
+    drafter = drafter_from_flag(args.spec, k=args.spec_k,
+                                data_dir=args.data_dir)
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
                     max_len=args.max_len or None,
-                    pipeline=not args.no_pipeline)
+                    pipeline=not args.no_pipeline, spec=drafter)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -96,9 +109,13 @@ def main(argv: list[str] | None = None) -> None:
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
           f"prefill program(s) ({args.warmup}), "
           f"{engine.trace_counts['admit']} admit, "
-          f"{engine.trace_counts['decode']} decode "
-          f"(pipeline={'off' if args.no_pipeline else 'on'})",
+          f"{engine.trace_counts['decode']} decode"
+          + (f", {engine.trace_counts.get('verify', 0)} verify "
+             f"(spec={args.spec}, k={args.spec_k})"
+             if args.spec != "off" else "")
+          + f" (pipeline={'on' if engine.pipeline else 'off'})",
           file=sys.stderr, flush=True)
+    engine.reset_latency_stats()  # /stats should describe live traffic
     loop = EngineLoop(engine)
     loop.start()
     server = make_server(args.host, args.port, loop, tok.encode,

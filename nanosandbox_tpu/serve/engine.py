@@ -47,6 +47,17 @@ Design (the TPU fixed-shape discipline, end to end):
     the device state the next step consumes is exactly the sampled
     token the host would have re-uploaded.
 
+  * Speculative decoding (spec=...): a drafter (serve/drafters.py)
+    guesses k tokens per slot and ONE fixed-shape verify program
+    (serve/spec.py) scores all k+1 positions per row against the slot
+    pool, accepting the longest target-agreed prefix plus one fresh
+    token — up to k+1 tokens per forward instead of 1, outputs
+    distributed exactly as non-spec decode (greedy: token-identical).
+    Spec steps replace the decode dispatch and run SYNCHRONOUSLY: a
+    host drafter needs the latest tokens to propose from, and the
+    verify readback (accepted lengths) gates the next frontier, so
+    the one-step pipeline lag has nothing to overlap.
+
 The engine is single-threaded by design (one step() == at most one
 decode dispatch + one lagged readback); http.py wraps it in a
 background thread for concurrent clients.
@@ -95,6 +106,7 @@ class _Active:
     slot: int
     tokens: List[int] = field(default_factory=list)
     first_token_t: float = 0.0   # wall clock of the prefill-token readback
+    spec_accepted: int = 0       # draft tokens this request accepted
 
 
 class Engine:
@@ -114,12 +126,19 @@ class Engine:
         read back, repeat — which bench.py uses as the comparison
         baseline; results are identical either way, only the
         dispatch/readback overlap differs.
+    spec : a drafter (serve/drafters.py NGramDrafter / ModelDrafter, or
+        anything matching the host protocol) enabling speculative
+        decoding: each "decode" step verifies k drafted tokens per slot
+        in one fixed-shape forward instead of computing one. Forces the
+        synchronous loop (see module docstring); greedy outputs are
+        token-identical to spec=None, sampled outputs identically
+        distributed.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 pipeline: bool = True):
+                 pipeline: bool = True, spec=None):
         import jax
         import jax.numpy as jnp
 
@@ -130,7 +149,10 @@ class Engine:
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
-        self.pipeline = bool(pipeline)
+        # Spec steps must read accepted lengths back before the next
+        # dispatch (and host drafters propose from the latest tokens),
+        # so speculative mode runs the synchronous loop.
+        self.pipeline = bool(pipeline) and spec is None
         self.max_len = min(max_len or cfg.block_size, cfg.block_size)
         buckets = (sorted(b for b in prefill_buckets if b <= self.max_len)
                    if prefill_buckets else default_buckets(self.max_len))
@@ -180,11 +202,31 @@ class Engine:
         # retrace instead of becoming a silent 10x serving slowdown.
         # Per-engine registry — tests spin up many engines.
         self.tracecheck = TraceBudgetRegistry()
-        budget = self.max_programs()
 
         # CPU jit ignores donation (and warns); only donate pool/state on
         # accelerators, where reusing the buffers in place matters.
         on_accel = jax.default_backend() != "cpu"
+
+        # Speculative layer: built before max_programs() so the verify
+        # (and any ModelDrafter draft/draft_prefill) budgets join the
+        # published compile set the guards enforce.
+        self._spec = None
+        if spec is not None:
+            from nanosandbox_tpu.serve.spec import SpecRunner
+
+            self._spec = SpecRunner(
+                spec, model=model, num_slots=num_slots,
+                max_len=self.max_len,
+                n_prefill_programs=(len(self.sched.buckets)
+                                    * len(self.admit_buckets)),
+                registry=self.tracecheck, on_accel=on_accel)
+        # Acceptance observability (bounded rings, like the latency
+        # signal): per-verify-row accepted lengths and per-request
+        # accepted-token totals.
+        self._spec_accept_len = RingStat(4096)
+        self._spec_req_accepted = RingStat(1024)
+
+        budget = self.max_programs()
         guard = self.tracecheck.guard
         self._prefill = jax.jit(
             guard("prefill", budget["prefill"])(self._prefill_fn),
@@ -341,6 +383,17 @@ class Engine:
         # tokens immediately frees slots for the next wave in line.
         self._admit_waves(finished)
 
+        if self._spec is not None:
+            # Speculative step: draft -> one fixed-shape verify ->
+            # retire, synchronously (any live row needs >= 1 more token
+            # by construction — rows finish the moment they hit budget).
+            if self._active:
+                self._spec_step(finished)
+                # Slots the retire just freed backfill NOW, same as the
+                # pipelined loop's post-retire admission.
+                self._admit_waves(finished)
+            return finished
+
         retired = False
         if self._active and self._needs_decode():
             self._pool, self._state, toks = self._decode(
@@ -378,6 +431,8 @@ class Engine:
         return out
 
     def stats(self) -> dict:
+        spec_stats = ({"enabled": False} if self._spec is None
+                      else self._spec.stats())
         return {
             "num_slots": self.num_slots,
             "max_len": self.max_len,
@@ -396,18 +451,33 @@ class Engine:
             "ttft_s": self._ttft.percentiles((50, 90, 99)),
             "tpot_s": self._tpot.percentiles((50, 90, 99)),
             "trace_counts": dict(self.trace_counts),
+            # Speculative signal: token-level acceptance rate, the mean
+            # accepted draft length per verify row (ring window), and
+            # per-request accepted-token totals (recorded at finish).
+            "spec": spec_stats,
+            "spec_acceptance_rate": spec_stats.get("acceptance_rate"),
+            "spec_accepted_len_mean": self._spec_accept_len.mean(),
+            "spec_req_accepted_tokens": self._spec_req_accepted.percentiles(
+                (50, 90, 99)),
         }
 
     def max_programs(self) -> dict:
         """The closed compile set by program kind — the budgets the
         tracecheck guards enforce at runtime (a retrace past these
         raises CompileBudgetExceeded) and tests/CI assert against."""
-        return {
+        progs = {
             "prefill": len(self.sched.buckets) * len(self.admit_buckets),
             "decode": 1,
             "admit": len(self.admit_buckets),
             "release": 1,
         }
+        if self._spec is not None:
+            # ONE verify shape (fixed num_slots x (k+1); per-row draft
+            # lengths are a mask, not a shape) — plus, for a
+            # ModelDrafter, one draft scan and the drafter's own
+            # (ladder x buckets) prefill grid.
+            progs.update(self._spec.programs)
+        return progs
 
     @property
     def trace_counts(self) -> Dict[str, int]:
@@ -450,13 +520,19 @@ class Engine:
             top_ks = jnp.asarray(top_ks)
             top_ps = jnp.asarray(top_ps)
             seeds = jnp.asarray(seeds)
+            prompts_dev = jnp.asarray(prompts)
             self._pool, toks = self._prefill(
-                self.params, self._pool, jnp.asarray(prompts), true_lens,
+                self.params, self._pool, prompts_dev, true_lens,
                 slots_dev, temps, top_ks, top_ps, seeds)
             # First tokens flow device-to-device into the slot state; the
             # host copy below is for result lists and finish checks only.
             self._state = self._admit(self._state, slots_dev, true_lens,
                                       toks, temps, top_ks, top_ps, seeds)
+            if self._spec is not None and self._spec.drafter.kind == "device":
+                # The drafter ingests the SAME staged wave into its own
+                # pool (its frontier state is the engine's pos/tok, so
+                # prompt K/V is all it needs).
+                self._spec.drafter.prefill_wave(prompts_dev, slots_dev)
             # jaxlint: disable=host-sync -- first-token readback feeds results/eos checks
             toks_host = np.asarray(toks)
             now = time.monotonic()
@@ -473,6 +549,80 @@ class Engine:
                 done = self._maybe_finish(st)
                 if done is not None:
                     finished.append(done)
+
+    def _spec_step(self, finished: List[Result]) -> None:
+        """One speculative round: collect per-row drafts (host prompt
+        lookup, or the compiled ModelDrafter scan), run the fixed-shape
+        verify, and retire the accepted prefix + one fresh token per
+        row — with per-token eos/length checks so a mid-chunk eos
+        truncates exactly where the non-spec loop would have stopped.
+
+        Per-row draft lengths are capped at remaining_budget - 1: the
+        verify always emits accepted+1 tokens, so the cap guarantees a
+        row can never overshoot max_new_tokens (greedy parity then
+        needs no trimming) nor write an accepted token past max_len
+        (submit already bounds prompt + max_new there)."""
+        import jax
+
+        k = self._spec.k
+        drafter = self._spec.drafter
+        caps = {slot: min(k, st.req.max_new_tokens - len(st.tokens) - 1)
+                for slot, st in self._active.items()}
+        dl = np.zeros(self.num_slots, np.int32)
+        if drafter.kind == "host":
+            # The ONLY per-step host->device transfer spec mode adds: the
+            # (num_slots, k) + (num_slots,) int32 blocks ride the verify
+            # dispatch itself (numpy args into jit measure ~25% cheaper
+            # per CPU verify than a separate device_put round).
+            drafts = np.zeros((self.num_slots, k), np.int32)
+            for slot, st in self._active.items():
+                if caps[slot] <= 0:
+                    continue
+                prop = drafter.propose(list(st.req.prompt) + st.tokens,
+                                       caps[slot])
+                dl[slot] = len(prop)
+                drafts[slot, :len(prop)] = prop
+        else:
+            drafts = drafter.draft(self._state["tok"], self._state["pos"],
+                                   self._state["active"])
+            for slot, cap in caps.items():
+                dl[slot] = max(cap, 0)
+        self._pool, self._state, emitted, counts, accepted = \
+            self._spec.verify(self.params, self._pool, self._state,
+                              drafts, dl)
+        self.steps += 1
+        self._spec.steps += 1
+        # ONE batched readback for the whole retire (synchronous by
+        # design — docstring; three separate np.asarray blocks cost a
+        # measurable slice of the verify step on CPU).
+        # jaxlint: disable=host-sync -- the spec retire: synchronous by design (docstring)
+        emit_host, counts_host, acc_host = jax.device_get(
+            (emitted, counts, accepted))
+        now = time.monotonic()
+        n_kept = 0
+        for slot, st in list(self._active.items()):
+            c = int(counts_host[slot])
+            if c <= 0:
+                continue
+            acc = int(acc_host[slot])
+            if dl[slot] > 0:
+                self._spec.drafted += int(dl[slot])
+                self._spec.accepted += acc
+                self._spec_accept_len.record(acc)
+                st.spec_accepted += acc
+            toks = emit_host[slot, :c].tolist()
+            if st.req.eos_id is not None and st.req.eos_id in toks:
+                # eos mid-chunk: the verify's tokens after it belong past
+                # the finish and are dropped — the spec twin of the
+                # pipelined ride-along drop.
+                toks = toks[:toks.index(st.req.eos_id) + 1]
+            st.tokens.extend(toks)
+            n_kept += len(toks)
+            done = self._maybe_finish(st)
+            if done is not None:
+                finished.append(done)
+        self.tokens_generated += n_kept
+        self._rate_ring.append((now, n_kept))
 
     def _needs_decode(self) -> bool:
         """False only when every active row's token budget is already
@@ -535,6 +685,14 @@ class Engine:
         self._tpot.clear()
         self._queue_wait.clear()
         self._rate_ring.clear()
+        self._spec_accept_len.clear()
+        self._spec_req_accepted.clear()
+        if self._spec is not None:
+            # Acceptance rate should describe the measured workload too —
+            # warmup prompts are degenerate (all-zero) and would skew it.
+            self._spec.steps = 0
+            self._spec.drafted = 0
+            self._spec.accepted = 0
 
     def _maybe_finish(self, state: _Active) -> Optional[Result]:
         import jax.numpy as jnp
@@ -555,6 +713,8 @@ class Engine:
         self._state = self._release(self._state,
                                     jnp.asarray(state.slot, jnp.int32))
         self.completed += 1
+        if self._spec is not None:
+            self._spec_req_accepted.record(state.spec_accepted)
         if len(state.tokens) > 1:
             self._tpot.record((time.monotonic() - state.first_token_t)
                               / (len(state.tokens) - 1))

@@ -1,0 +1,214 @@
+"""Speculative decoding: fixed-shape batched verification for the Engine.
+
+The decode hot loop's floor is one target-model forward per emitted
+token. Speculative decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding") raises that to up-to-k+1 tokens
+per forward: a cheap drafter (serve/drafters.py) guesses the next k
+tokens of every slot, ONE batched target forward scores all k+1
+positions against the slot KV pool, and rejection sampling keeps the
+longest prefix the target agrees with plus one freshly sampled token —
+with the output distribution provably identical to non-speculative
+decoding (greedy: token-for-token identical, pinned by test).
+
+The TPU discipline survives intact:
+
+  * ONE verify program, ever. The verify block is a fixed
+    (num_slots, k+1) shape; per-row draft LENGTHS vary via a mask, so a
+    slot whose drafter found nothing (draft_len 0) rides the same
+    program as a slot with k hot drafts — mixed spec/non-spec slots
+    coexist in one batch, and the compile set stays closed
+    (Engine.max_programs() gains {'verify': 1}, plus the ModelDrafter's
+    {'draft': 1, 'draft_prefill': ladder x buckets}).
+
+  * Cache-frontier rollback is FREE. The verify forward writes K/V for
+    all k+1 positions through the per-row drop-mode scatter in
+    models/gpt.py; when only a of k drafts are accepted, the engine
+    simply does not advance ``pos`` past the accepted prefix. The stale
+    columns beyond the new frontier are overwritten by the very next
+    verify block (which spans them by construction: the new frontier
+    plus k+1 columns covers everything the rejected tail wrote) before
+    any query attends to them — the same argument that already lets a
+    released slot's garbage sit in the pool.
+
+  * The sampling-stream contract narrows, it does not break. The token
+    destined for position q is still drawn from fold_in(key(seed), q)
+    (sample.row_keys); accept/reject coins use an extra fold_in(·, 1)
+    so they never correlate with the sample draw. A row with draft_len
+    0 therefore emits EXACTLY the token the non-speculative decode step
+    would — even at temperature > 0 — so turning spec on is safe for
+    workloads the drafter can't help.
+
+Rejection rule (greedy drafters propose point masses): accept draft d
+at position q with probability p_q(d) under the TARGET's filtered
+distribution (temperature/top-k/top-p — shared with the decode step via
+sample._filter_logits_rows, so verify and decode can never drift); on
+the first rejection, resample from p_q with d's mass zeroed and
+renormalized (categorical over masked logits does the renormalization).
+Greedy rows (temperature 0) reduce to exact-match accept against the
+raw-logits argmax. Either way each verify emits between 1 and k+1
+tokens per live row — never fewer than plain decode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class SpecRunner:
+    """Owns the speculative state the Engine delegates to: the drafter,
+    the compiled verify program, and the acceptance accounting.
+
+    Built by Engine.__init__ (spec=...); the Engine remains the only
+    code that touches the slot pool / slot state — SpecRunner's verify
+    is a pure function of them, threaded through exactly like the
+    decode step (donated on accelerators)."""
+
+    def __init__(self, drafter, *, model, num_slots: int, max_len: int,
+                 n_prefill_programs: int, registry, on_accel: bool):
+        import jax
+
+        self.drafter = drafter
+        self.model = model
+        self.k = int(drafter.k)
+        self.num_slots = num_slots
+        if self.k < 1:
+            raise ValueError(f"drafter k must be >= 1, got {self.k}")
+        if max_len < 2:
+            raise ValueError("speculative decoding needs max_len >= 2")
+        self.programs = {"verify": 1}
+        if drafter.kind == "device":
+            self.programs.update(drafter.build(
+                target_cfg=model.cfg, num_slots=num_slots, max_len=max_len,
+                n_prefill_programs=n_prefill_programs, registry=registry,
+                on_accel=on_accel))
+        self._verify = jax.jit(
+            registry.guard("verify", self.programs["verify"])(
+                self._verify_fn),
+            donate_argnums=(1, 2) if on_accel else ())
+        # Token-level acceptance counters (host side, monotonic).
+        self.steps = 0
+        self.drafted = 0
+        self.accepted = 0
+
+    # ------------------------------------------------------------------
+    def verify(self, params, pool, state, drafts, draft_len):
+        """One speculative step over all slots. Returns
+        (pool, state, emitted (S, k+1), counts (S,), accepted (S,)) —
+        emitted[r, :counts[r]] are row r's new tokens, accepted[r] how
+        many of them were drafter guesses (counts = accepted + 1 for
+        live rows, 0 for parked ones)."""
+        return self._verify(params, pool, state, drafts, draft_len)
+
+    def _verify_fn(self, params, pool, state, drafts, draft_len):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from nanosandbox_tpu.sample import _filter_logits_rows, row_keys
+
+        S, K = drafts.shape
+        # Input block per row: [current token, d_1 .. d_K] at positions
+        # pos .. pos+K. Offset i's logits predict position pos+i+1.
+        toks_in = jnp.concatenate([state["tok"][:, None], drafts], axis=1)
+        logits, pool = self.model.apply({"params": params}, toks_in,
+                                        deterministic=True, cache=pool,
+                                        cache_index=state["pos"])
+        logits = logits.astype(jnp.float32)              # (S, K+1, V)
+        V = logits.shape[-1]
+        t = state["temp"]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # raw argmax
+        in_len = jnp.arange(K)[None, :] < draft_len[:, None]
+        rows = jnp.arange(S)
+
+        def _greedy_path(_):
+            # All rows greedy: accept is exact argmax match, the +1
+            # token is the argmax at the accepted frontier — no filter,
+            # no softmax, no PRNG work runs at all.
+            accept = (drafts == greedy[:, :K]) & in_len
+            a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+            return a, greedy[rows, a]
+
+        def _sampled_path(_):
+            # The TARGET distribution at every offset, under each row's
+            # own sampling settings — the same filter the decode step
+            # samples from (sample._filter_logits_rows), which is what
+            # makes the rejection rule exact.
+            filt = _filter_logits_rows(
+                logits.reshape(S * (K + 1), V),
+                temperature=jnp.repeat(t, K + 1),
+                top_k=jnp.repeat(state["topk"], K + 1),
+                top_p=jnp.repeat(state["topp"], K + 1)).reshape(S, K + 1, V)
+            probs = jax.nn.softmax(filt, axis=-1)
+
+            # Sampling-stream contract: position q's draw uses
+            # fold_in(key(seed), q); the accept coin for q folds in one
+            # more step so it never correlates with the draw.
+            positions = (state["pos"][:, None] + 1
+                         + jnp.arange(K + 1)[None, :])     # (S, K+1)
+            keys = row_keys(jnp.repeat(state["seed"], K + 1),
+                            positions.reshape(-1)).reshape(S, K + 1)
+            coin = jax.vmap(jax.vmap(
+                lambda kk: jax.random.uniform(
+                    jax.random.fold_in(kk, 1))))(keys)
+
+            # Accept: greedy rows exact-match the argmax; sampled rows
+            # flip the p(d) coin. Offsets past the row's draft length
+            # never accept (the per-row mask that lets mixed draft
+            # lengths share one program).
+            p_draft = jnp.take_along_axis(
+                probs[:, :K, :], drafts[..., None], axis=-1)[..., 0]
+            accept = jnp.where(t[:, None] == 0.0, drafts == greedy[:, :K],
+                               coin[:, :K] < p_draft) & in_len
+            a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+            # The +1 token at offset a: on a rejection, resample from
+            # the target distribution with the rejected draft's mass
+            # removed (the point-mass residual max(0, p - q)
+            # normalized); when every draft was accepted (or none were
+            # proposed) it is a FULL sample from p — the bonus token,
+            # and for draft_len 0 rows exactly the non-speculative
+            # decode draw, key and all.
+            rejected = a < draft_len
+            filt_a = filt[rows, a]                               # (S, V)
+            d_a = drafts[rows, jnp.minimum(a, K - 1)]
+            resample_mask = rejected[:, None] & (jnp.arange(V)[None, :]
+                                                 == d_a[:, None])
+            sample_logits = jnp.where(resample_mask, -1e30, filt_a)
+            sampled = jax.vmap(jax.random.categorical)(
+                keys[rows, a], sample_logits).astype(jnp.int32)
+            return a, jnp.where(t == 0.0, greedy[rows, a], sampled)
+
+        # ONE program either way (XLA cond, not a retrace): the all-
+        # greedy batch — the serving common case — runs the cheap
+        # branch; any sampled row switches the whole batch to the full
+        # rejection-sampling path (greedy rows inside it still get their
+        # exact-match/argmax semantics via the per-row masks).
+        a, out = lax.cond(jnp.any(t > 0.0), _sampled_path, _greedy_path,
+                          None)
+
+        active = state["active"]
+        live = active.astype(jnp.int32)
+        off = jnp.arange(K + 1)[None, :]
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1)
+        emitted = jnp.where(off < a[:, None], drafts_pad,
+                            jnp.where(off == a[:, None], out[:, None], 0))
+        counts = (a + 1) * live
+        new_state = dict(state,
+                         pos=state["pos"] + (a + 1) * live,
+                         tok=jnp.where(active, out, state["tok"]))
+        return pool, new_state, emitted, counts, a * live
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        rate: Optional[float] = (self.accepted / self.drafted
+                                 if self.drafted else None)
+        return {
+            "enabled": True,
+            "drafter": type(self.drafter).__name__,
+            "k": self.k,
+            "verify_steps": self.steps,
+            "tokens_drafted": self.drafted,
+            "tokens_accepted": self.accepted,
+            "acceptance_rate": rate,
+        }

@@ -1,0 +1,498 @@
+"""Speculative-decoding tests: drafters + fixed-shape batched verify.
+
+The contract under test (ISSUE 4 acceptance bar):
+  * exact greedy token-parity with the non-speculative engine (and so,
+    transitively, with single-request sample.generate) for BOTH drafter
+    backends, under mixed batches where speculating and non-speculating
+    rows share one verify program;
+  * rollback correctness: a drafter that is ALWAYS wrong still yields
+    exact outputs (the rejected tail's K/V is overwritten before any
+    query attends to it) and never slows a row below one token per
+    verify;
+  * mid-chunk eos truncates exactly where the non-spec loop would have
+    stopped, and the freed slot's next occupant is unaffected;
+  * the compile set stays closed: ONE verify program (+ the
+    ModelDrafter's draft/draft_prefill grid), asserted via the engine's
+    TraceBudgetRegistry and enforced under frozen();
+  * temperature > 0 rejection sampling preserves the output
+    distribution (seeded two-sided frequency check on a tiny vocab).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT
+from nanosandbox_tpu.sample import generate
+from nanosandbox_tpu.serve import (Engine, ModelDrafter, NGramDrafter,
+                                   drafter_from_flag)
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture(scope="module")
+def draft_model(served_model):
+    """A smaller GPT sharing the target's vocab + block size — the
+    ModelDrafter contract."""
+    cfg, _, _ = served_model
+    dcfg = GPTConfig(n_layer=1, n_head=2, n_embd=16,
+                     block_size=cfg.block_size, vocab_size=cfg.vocab_size,
+                     dropout=0.0, compute_dtype="float32",
+                     attention_impl="xla")
+    dmodel = GPT(dcfg)
+    dparams = dmodel.init(jax.random.key(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    return dcfg, dmodel, dparams
+
+
+def _ref_greedy(model, params, prompt, max_new, block_size):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32), max_new,
+                   temperature=0.0, top_k=0, rng=jax.random.key(0),
+                   block_size=block_size)
+    return [int(t) for t in out[0, len(prompt):]]
+
+
+def _mixed_workload(cfg, seed, n):
+    """Half repetitive prompts (the drafter's favorable regime), half
+    independent-random (ngram mostly misses -> draft_len-0 rows), so
+    speculating and non-speculating rows share verify batches."""
+    rng = np.random.default_rng(seed)
+    work = []
+    for i in range(n):
+        L = int(rng.integers(2, 30))
+        if i % 2 == 0:
+            motif = rng.integers(0, cfg.vocab_size, int(rng.integers(2, 4)))
+            prompt = np.tile(motif, L // len(motif) + 1)[:L].tolist()
+        else:
+            prompt = [int(x) for x in rng.integers(0, cfg.vocab_size, L)]
+        work.append((prompt, int(rng.integers(1, 16))))
+    return work
+
+
+def _spec_budget_ok(eng):
+    eng.tracecheck.assert_within_budget()
+    assert eng.tracecheck.budgets() == eng.max_programs()
+    assert eng.max_programs()["verify"] == 1
+    assert eng.trace_counts["verify"] <= 1
+
+
+class _ScriptedDrafter:
+    """Host drafter that proposes from a per-prompt script indexed by how
+    many tokens the request has generated so far — lets a test pin the
+    drafter to be exactly wrong (full-reject rollback) or exactly right
+    (oracle) against a precomputed reference stream."""
+
+    kind = "host"
+
+    def __init__(self, scripts, k=4):
+        # scripts: {prompt tuple: [token per generated position]}
+        self.scripts = scripts
+        self.k = k
+
+    def propose(self, context, max_tokens=None):
+        cap = self.k if max_tokens is None else min(self.k, max_tokens)
+        ctx = tuple(int(t) for t in context)
+        for prompt, script in self.scripts.items():
+            if ctx[:len(prompt)] == prompt:
+                done = len(ctx) - len(prompt)
+                return script[done:done + cap]
+        return []
+
+
+class _ConstDrafter:
+    """Propose a fixed token at every offset — acceptance probability is
+    then exactly the target's p(token), the cleanest handle for the
+    distribution-preservation test."""
+
+    kind = "host"
+
+    def __init__(self, token, k=2):
+        self.token = int(token)
+        self.k = k
+
+    def propose(self, context, max_tokens=None):
+        cap = self.k if max_tokens is None else min(self.k, max_tokens)
+        return [self.token] * max(cap, 0)
+
+
+# -------------------------------------------------------------- greedy parity
+
+def test_ngram_greedy_parity_mixed_batch_and_budget(served_model):
+    """10 mixed requests through 4 slots (backfill mid-flight), half
+    repetitive / half random prompts: every output token-for-token equal
+    to the non-spec reference, one verify program total."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 spec=NGramDrafter(k=4))
+    reqs = [(eng.submit(p, m), p, m)
+            for p, m in _mixed_workload(cfg, seed=7, n=10)]
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res) == 10
+    for rid, prompt, mnt in reqs:
+        assert res[rid].tokens == _ref_greedy(model, params, prompt, mnt,
+                                              cfg.block_size), rid
+    _spec_budget_ok(eng)
+    s = eng.stats()
+    assert s["spec"]["enabled"] is True
+    assert s["spec"]["verify_steps"] > 0
+    # The repetitive half must actually speculate for this test to mean
+    # anything (draft_len-0 rows alone would vacuously pass parity).
+    assert s["spec"]["tokens_accepted"] > 0
+
+
+def test_model_drafter_greedy_parity_and_budget(served_model, draft_model):
+    """Same parity bar for the device drafter: a small same-tokenizer GPT
+    drafting greedily against its own slot pool."""
+    cfg, model, params = served_model
+    _, dmodel, dparams = draft_model
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 spec=ModelDrafter(dmodel, dparams, k=3))
+    reqs = [(eng.submit(p, m), p, m)
+            for p, m in _mixed_workload(cfg, seed=13, n=8)]
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res) == 8
+    for rid, prompt, mnt in reqs:
+        assert res[rid].tokens == _ref_greedy(model, params, prompt, mnt,
+                                              cfg.block_size), rid
+    _spec_budget_ok(eng)
+    progs = eng.max_programs()
+    assert progs["draft"] == 1
+    assert progs["draft_prefill"] == progs["prefill"]
+    assert eng.trace_counts["draft"] <= 1
+
+
+def test_full_reject_rollback_exact(served_model):
+    """A drafter that is wrong at EVERY position: every verify fully
+    rejects, the cache frontier rolls back every step (the rejected
+    tail's K/V sits in the pool until overwritten), and the output is
+    still exact — at exactly one token per verify, never slower than
+    plain decode."""
+    cfg, model, params = served_model
+    prompt = (5, 3, 1, 4)
+    ref = _ref_greedy(model, params, list(prompt), 12, cfg.block_size)
+    wrong = [(t + 1) % cfg.vocab_size for t in ref]
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 spec=_ScriptedDrafter({prompt: wrong}, k=4))
+    rid = eng.submit(prompt, 12)
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid].tokens == ref
+    s = eng.stats()
+    assert s["spec"]["tokens_accepted"] == 0
+    assert s["spec_acceptance_rate"] == 0.0
+    _spec_budget_ok(eng)
+
+
+def test_oracle_drafter_fewer_forwards(served_model):
+    """The flip side: a drafter that is right at every position collapses
+    max_new tokens into ~max_new/(k+1) verifies — the whole point of the
+    subsystem, pinned here at the step-count level (CPU wall-clock is
+    bench.py's job)."""
+    cfg, model, params = served_model
+    prompt = (2, 7, 2, 7)
+    max_new = 13
+    ref = _ref_greedy(model, params, list(prompt), max_new, cfg.block_size)
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 spec=_ScriptedDrafter({prompt: ref}, k=4))
+    rid = eng.submit(prompt, max_new)
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid].tokens == ref
+    s = eng.stats()
+    assert s["spec_acceptance_rate"] == 1.0
+    # 12 post-prefill tokens at up to 5/verify: 3 verifies suffice
+    # (drafts are capped at remaining-1, so the last chunk is partial).
+    assert s["spec"]["verify_steps"] <= 4
+
+
+def test_spec_eos_mid_chunk_truncates_exactly(served_model):
+    """An eos landing MID verify-chunk: the accepted tokens after it are
+    dropped, finish_reason is eos, and the freed slot's next occupant
+    decodes exactly as if the engine were fresh."""
+    cfg, model, params = served_model
+    prompt = ref = idx = None
+    for cand in ([5, 3], [6, 6, 2], [42, 13, 27, 33], [49, 48, 47]):
+        r = _ref_greedy(model, params, cand, 12, cfg.block_size)
+        novel = [i for i in range(2, len(r) - 1) if r[i] not in r[:i]]
+        if novel:
+            prompt, ref, idx = cand, r, novel[0]
+            break
+    assert prompt is not None, "no candidate prompt with a mid-stream " \
+        "novel greedy token; extend the candidate list"
+    eos = ref[idx]
+    # Oracle drafts guarantee the eos arrives inside an accepted chunk
+    # (k=4 spans it) rather than as a lone bonus token.
+    eng = Engine(model, params, num_slots=1, max_len=64,
+                 spec=_ScriptedDrafter({tuple(prompt): ref}, k=4))
+    rid_a = eng.submit(prompt, 12, eos_id=eos)
+    rid_b = eng.submit([9, 9], 6)    # backfills the SAME slot afterwards
+    res = {r.rid: r for r in eng.drain()}
+    assert res[rid_a].tokens == ref[:idx + 1]
+    assert res[rid_a].finish_reason == "eos"
+    assert res[rid_b].tokens == _ref_greedy(model, params, [9, 9], 6,
+                                            cfg.block_size)
+    assert eng.stats()["free_slots"] == 1
+
+
+# ------------------------------------------------------------- compile budget
+
+def test_verify_budget_under_frozen_registry(served_model):
+    """The post-warmup serving contract extends to the spec programs:
+    once the verify (and prefill set) is compiled, a frozen registry
+    admits any further speculative traffic without a single retrace —
+    and a shape that WOULD need a new program still fails loudly."""
+    from nanosandbox_tpu.utils.tracecheck import CompileBudgetExceeded
+
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 spec=NGramDrafter(k=4))
+    eng.submit([1, 2, 1, 2, 1, 2], 8)   # rung-1 wave
+    eng.submit([5, 6, 5, 6], 8)
+    eng.submit([6, 5, 6], 8)            # rung-2 wave (backfill pair)
+    eng.drain()                         # verify + bucket-16 rungs compiled
+    assert eng.trace_counts["verify"] == 1
+    with eng.tracecheck.frozen():
+        # Different draft lengths, mixed hit/miss rows, different
+        # temperature mix: all the SAME verify shape — zero retraces.
+        eng.submit([3, 4, 3, 4, 3], 6)
+        eng.submit([9, 8, 7], 5, temperature=0.7, top_k=5, seed=11)
+        eng.drain()
+        eng.submit([9] * 20, 2)       # bucket 32: needs a NEW prefill
+        with pytest.raises(CompileBudgetExceeded, match="frozen"):
+            eng.drain()
+    assert eng.trace_counts["verify"] == 1
+    eng.tracecheck.assert_within_budget()
+
+
+def test_spec_stats_surface(served_model):
+    """Engine.stats() (and therefore serve's /stats) carries the
+    acceptance signal: rate, mean accepted length, per-request accepted
+    totals — and the non-spec engine reports enabled=False with null
+    fields instead of omitting the keys."""
+    cfg, model, params = served_model
+    plain = Engine(model, params, num_slots=1, max_len=64)
+    s0 = plain.stats()
+    assert s0["spec"] == {"enabled": False}
+    assert s0["spec_acceptance_rate"] is None
+
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 spec=NGramDrafter(k=4))
+    rid = eng.submit([1, 2, 1, 2, 1, 2, 1, 2], 10)
+    res = {r.rid: r for r in eng.drain()}
+    assert len(res[rid].tokens) == 10
+    s = eng.stats()
+    assert s["spec"]["drafter"] == "NGramDrafter"
+    assert s["spec"]["k"] == 4
+    assert s["spec"]["tokens_drafted"] >= s["spec"]["tokens_accepted"] > 0
+    assert s["spec_acceptance_rate"] == pytest.approx(
+        s["spec"]["tokens_accepted"] / s["spec"]["tokens_drafted"])
+    assert s["spec_accepted_len_mean"] is not None
+    assert s["spec_req_accepted_tokens"]["p50"] is not None
+
+
+def test_drafter_validation():
+    """Bad drafter configs fail at construction, not mid-flight."""
+    with pytest.raises(ValueError, match="k must be"):
+        NGramDrafter(k=0)
+    with pytest.raises(ValueError, match="max_ngram"):
+        NGramDrafter(max_ngram=0)
+    assert drafter_from_flag("off") is None
+    assert drafter_from_flag("") is None
+    assert isinstance(drafter_from_flag("ngram", k=3), NGramDrafter)
+    with pytest.raises(ValueError, match="model:<out_dir>"):
+        drafter_from_flag("model:")
+    with pytest.raises(ValueError, match="unknown --spec"):
+        drafter_from_flag("bogus")
+
+
+def test_model_drafter_rejects_mismatched_model(served_model):
+    """Vocabulary or context mismatch between drafter and target is a
+    construction-time error — drafts are token ids, so the models must
+    share one tokenizer and the drafter must reach every frontier."""
+    cfg, model, params = served_model
+    bad_vocab = GPTConfig(n_layer=1, n_head=2, n_embd=16, block_size=64,
+                          vocab_size=cfg.vocab_size + 1, dropout=0.0,
+                          compute_dtype="float32", attention_impl="xla")
+    bmodel = GPT(bad_vocab)
+    bparams = bmodel.init(jax.random.key(2),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="vocab_size"):
+        Engine(model, params, num_slots=2, max_len=64,
+               spec=ModelDrafter(bmodel, bparams, k=2))
+
+    short_ctx = GPTConfig(n_layer=1, n_head=2, n_embd=16, block_size=32,
+                          vocab_size=cfg.vocab_size, dropout=0.0,
+                          compute_dtype="float32", attention_impl="xla")
+    smodel = GPT(short_ctx)
+    sparams = smodel.init(jax.random.key(3),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="block_size"):
+        Engine(model, params, num_slots=2, max_len=64,
+               spec=ModelDrafter(smodel, sparams, k=2))
+
+
+def test_model_drafter_cache_consistent_after_full_accept(served_model,
+                                                          draft_model):
+    """The drafter pool must stay coherent through a FULL-accept round:
+    when all k drafts are accepted the engine's frontier jumps k+1
+    columns, so the k-th draft's K/V column is queried by every later
+    draft — if the draft scan never wrote it (the k-step version of
+    _draft_fn), round-2 drafts silently diverge from the draft model's
+    true greedy predictions for the rest of the request. Pinned by
+    exact parity against a cache-free dense re-run of the draft model
+    over the full accepted sequence."""
+    cfg, model, params = served_model
+    _, dmodel, dparams = draft_model
+    from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
+
+    k = 3
+    drafter = ModelDrafter(dmodel, dparams, k=k)
+    drafter.build(target_cfg=cfg, num_slots=2, max_len=32,
+                  n_prefill_programs=4, registry=TraceBudgetRegistry(),
+                  on_accel=False)
+    # A previous occupant fills slot 0's pool row first: prefill only
+    # rewrites columns [0, L) (scatter_cache_rows), so its K/V survives
+    # past the new prompt's length — the exact garbage the never-written
+    # column would expose (an all-zero fresh pool is too benign to flip
+    # a tiny model's argmax, a real stale row is not).
+    junk = [int(x) for x in np.random.default_rng(3).integers(
+        0, cfg.vocab_size, 24)]
+    drafter.prefill_wave(jnp.asarray([junk, junk], jnp.int32),
+                         jnp.asarray([0, 1], jnp.int32))
+    prompt = [1, 2, 3, 4, 5]
+    L = len(prompt)
+    drafter.prefill_wave(jnp.asarray([prompt, prompt], jnp.int32),
+                         jnp.asarray([0, 1], jnp.int32))
+
+    def dense_greedy(seq, n):
+        out = []
+        for _ in range(n):
+            logits = dmodel.apply({"params": dparams},
+                                  jnp.asarray([seq], jnp.int32),
+                                  deterministic=True)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            seq = seq + [nxt]
+        return out
+
+    active = jnp.asarray([True, False])
+    t0 = 7
+    r1 = np.asarray(drafter.draft(jnp.asarray([t0, 0], jnp.int32),
+                                  jnp.asarray([L, L], jnp.int32), active))
+    assert r1[0].tolist() == dense_greedy(prompt + [t0], k)
+
+    # Full accept: the engine advances pos by k+1, so the NEXT draft
+    # call queries across column L+k — the k-th draft's K/V, which only
+    # the scan's extra (k+1)-th step writes. Pin the invariant at the
+    # cache level (argmax parity alone is too blunt on a tiny model):
+    # slot 0's pool columns [0, L+k] must match a from-scratch prefill
+    # of the full accepted sequence, every layer.
+    from nanosandbox_tpu.models.gpt import init_cache
+
+    seq_acc = prompt + [t0] + r1[0].tolist()          # columns 0..L+k
+    _, ref_cache = dmodel.apply(
+        {"params": dparams}, jnp.asarray([seq_acc], jnp.int32),
+        deterministic=True, cache=init_cache(dmodel.cfg, 1, 32),
+        cache_index=0)
+    n_cols = len(seq_acc)
+    for li, ((pk, pv), (rk, rv)) in enumerate(zip(drafter._pool,
+                                                  ref_cache)):
+        np.testing.assert_allclose(
+            np.asarray(pk[0, :, :n_cols]), np.asarray(rk[0, :, :n_cols]),
+            atol=1e-5, err_msg=f"K layer {li}")
+        np.testing.assert_allclose(
+            np.asarray(pv[0, :, :n_cols]), np.asarray(rv[0, :, :n_cols]),
+            atol=1e-5, err_msg=f"V layer {li}")
+
+    # And the round-2 drafts (queries spanning that column) still match
+    # the cache-free dense reference.
+    bonus = 9
+    seq = seq_acc + [bonus]
+    r2 = np.asarray(drafter.draft(jnp.asarray([bonus, 0], jnp.int32),
+                                  jnp.asarray([L + k + 1, L], jnp.int32),
+                                  active))
+    assert r2[0].tolist() == dense_greedy(seq, k)
+
+
+# ------------------------------------------------- distribution preservation
+
+def test_temperature_rejection_sampling_preserves_distribution():
+    """Leviathan-rule correctness at temperature > 0, empirically: on a
+    tiny vocab, the per-position token frequencies of the speculative
+    engine match the non-speculative engine across many seeded requests
+    (two-sided max-abs-frequency check; each engine's run is fully
+    deterministic given the seed set, so the tolerance is stable, not
+    flaky). The constant drafter makes the accept probability exactly
+    the target's p(token), so both the accept and the
+    resample-with-mass-removed paths are exercised."""
+    V = 13
+    cfg = GPTConfig(n_layer=1, n_head=2, n_embd=16, block_size=16,
+                    vocab_size=V, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(4),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt, max_new, n_seeds = [1, 2, 1, 2], 3, 800
+
+    def collect(drafter):
+        eng = Engine(model, params, num_slots=8, max_len=16, spec=drafter)
+        rids = [eng.submit(prompt, max_new, temperature=1.0, seed=s)
+                for s in range(n_seeds)]
+        res = {r.rid: r.tokens for r in eng.drain()}
+        toks = np.asarray([res[r] for r in rids])     # (n_seeds, max_new)
+        counts = np.stack([np.bincount(toks[:, j], minlength=V)
+                           for j in range(max_new)])  # (max_new, V)
+        return eng, counts / n_seeds
+
+    base_eng, base_freq = collect(None)
+    spec_eng, spec_freq = collect(_ConstDrafter(token=5, k=2))
+
+    s = spec_eng.stats()
+    # Both rejection paths ran: some drafts accepted, some rejected.
+    assert 0.0 < s["spec_acceptance_rate"] < 1.0
+    # Position 0 comes from the prefill in both engines — same seeded
+    # stream, so the frequencies are IDENTICAL, a built-in control that
+    # the comparison itself is sound.
+    np.testing.assert_allclose(spec_freq[0], base_freq[0], atol=1e-12)
+    # Positions 1..: verify-emitted (accept / resample / bonus). Two
+    # independent N-sample draws from the same distribution: bound the
+    # max per-token frequency gap. std of a freq diff is at most
+    # sqrt(0.5/N) ~ 0.025 at N=800; 0.06 is ~2.4 sigma on the worst
+    # token but the run is deterministic — this documents the margin.
+    gap = np.abs(spec_freq[1:] - base_freq[1:]).max()
+    assert gap < 0.06, f"frequency gap {gap:.4f} (spec vs base)"
+    # And the drafted token's own frequency did not inflate (the classic
+    # always-accept bug would push it toward 1.0).
+    assert abs(spec_freq[1][5] - base_freq[1][5]) < 0.06
+
+
+# ----------------------------------------------------------------- bench hook
+
+def test_bench_decode_spec_mode():
+    import bench
+
+    result = bench.bench_decode(
+        {"num_slots": "2", "max_new_tokens": "6", "requests": "4",
+         "spec": "ngram", "spec_k": "3", "repetitive": "1"},
+        quick=True, on_tpu=False)
+    extra = result["extra"]
+    assert extra["spec"] == "ngram"
+    assert extra["spec_k"] == 3
+    assert extra["spec_tokens_per_sec"] > 0
+    assert extra["spec_vs_baseline"] == pytest.approx(
+        extra["spec_tokens_per_sec"] / extra["pipelined_tokens_per_sec"])
+    assert extra["spec_tokens_generated"] == extra["tokens_generated"]
+    assert 0.0 <= extra["acceptance_rate"] <= 1.0
+
+    with pytest.raises(SystemExit):
+        bench.bench_decode({"spec": "model:/nope"}, quick=True,
+                           on_tpu=False)
