@@ -15,7 +15,11 @@ per-device hardware).
 Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
        python bench.py --mode=decode [--quick] [--num_slots=N] \
            [--max_new_tokens=N] [--requests=N] [--mixed=1] \
-           [--spec={off,ngram}] [--spec_k=N] [--repetitive] [--repeat=N]
+           [--spec={off,ngram}] [--spec_k=N] [--repetitive] [--repeat=N] \
+           [--emit_obs]
+
+--emit_obs attaches the obs metric-registry snapshot (the same series a
+live /metrics scrape exposes) to the JSON under "obs".
 
 Decode mode reports pipelined AND synchronous tokens/sec (plus TTFT
 percentiles) so the pipelining win is trend-tracked in CI, no threshold.
@@ -32,6 +36,12 @@ import sys
 import tempfile
 
 A10_BASELINE_TOKS_PER_SEC = 22_000.0
+
+
+def _flag(kv: dict, name: str) -> bool:
+    """One boolean-flag parse for every `--name[=0|false|no]` switch —
+    the hand-rolled variants had already drifted across call sites."""
+    return name in kv and kv[name] not in ("0", "false", "no")
 
 
 def preflight_impls() -> dict[str, str]:
@@ -155,15 +165,14 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     num_slots = int(kv.get("num_slots", kv.get("slots", 8)))
     max_new = int(kv.get("max_new_tokens", max_new))
     n_requests = int(kv.get("requests", 2 * num_slots))
-    mixed = "mixed" in kv and kv["mixed"] not in ("0", "false", "no")
+    mixed = _flag(kv, "mixed")
     spec = kv.get("spec", "off")
     if spec not in ("off", "ngram"):
         # ModelDrafter needs a restored checkpoint; the bench initializes
         # random weights, so only the weight-free drafter is benchable.
         raise SystemExit(f"--spec={spec!r}: decode bench supports off|ngram")
     spec_k = int(kv.get("spec_k", 4))
-    repetitive = ("repetitive" in kv
-                  and kv["repetitive"] not in ("0", "false", "no"))
+    repetitive = _flag(kv, "repetitive")
 
     model = GPT(cfg)
     params = model.init(jax.random.key(0),
@@ -266,6 +275,20 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         })
 
     sync_rate = median(rates["sync"])
+    obs_extra = {}
+    if _flag(kv, "emit_obs"):
+        # --emit_obs: attach the full metric-registry snapshots (plus
+        # the process-global ledgers) so a bench artifact carries the
+        # SAME series a live /metrics scrape would — compile counts,
+        # latency histograms — not just the headline rate. The spec
+        # acceptance families live on the SPEC engine's registry, so it
+        # gets its own snapshot when --spec is on.
+        from nanosandbox_tpu.obs import global_registry
+        obs_extra["obs"] = {"engine": engine.metrics.snapshot(),
+                            "process": global_registry().snapshot()}
+        if spec != "off":
+            obs_extra["obs"]["spec_engine"] = \
+                engines["spec"].metrics.snapshot()
     return {
         "metric": "gpt2_124m_batched_decode_tokens_per_sec" if on_tpu
         else "tiny_batched_decode_tokens_per_sec_cpu",
@@ -297,6 +320,7 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "repetitive": repetitive,
             **spec_extra,
         },
+        **obs_extra,
     }
 
 
@@ -307,6 +331,8 @@ def main(argv: list[str]) -> dict:
         kv.setdefault("mixed", "1")
     if "--repetitive" in argv:
         kv.setdefault("repetitive", "1")
+    if "--emit_obs" in argv:
+        kv.setdefault("emit_obs", "1")
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
@@ -353,6 +379,9 @@ def main(argv: list[str]) -> dict:
             "loss": m["loss"],
         },
     }
+    if _flag(kv, "emit_obs"):
+        from nanosandbox_tpu.obs import global_registry
+        result["obs"] = {"process": global_registry().snapshot()}
     print(json.dumps(result))
     return result
 

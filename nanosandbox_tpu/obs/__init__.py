@@ -1,0 +1,28 @@
+"""Unified telemetry spine: metric registry + span tracer.
+
+Three halves (ISSUE 5), all pure host-side stdlib — no jax import, no
+device readback, nothing for jaxlint to flag:
+
+  registry.py — process/instance MetricRegistry: named Counter / Gauge /
+                Histogram families with labels, JSON ``snapshot()``, and
+                Prometheus text exposition. Engines, the Trainer, the
+                tracecheck ledgers and warn_once all publish here.
+  tracer.py   — SpanTracer: begin/end spans recorded from already-
+                host-resident dispatch-time state, bounded ring,
+                request-id correlation, Chrome trace-event JSON export
+                (Perfetto-loadable) per request or per time window.
+
+The serving surface (serve/http.py) exposes both: ``GET /metrics``
+(Prometheus scrape), ``GET /trace?rid=N`` (one request's timeline),
+``POST /profile`` (an on-demand jax.profiler window over the live
+serve loop).
+"""
+
+from nanosandbox_tpu.obs.registry import (DEFAULT_BUCKETS, MetricFamily,
+                                          MetricRegistry, global_registry,
+                                          render_prometheus)
+from nanosandbox_tpu.obs.tracer import ENGINE_TRACK, Span, SpanTracer
+
+__all__ = ["MetricRegistry", "MetricFamily", "SpanTracer", "Span",
+           "global_registry", "render_prometheus", "DEFAULT_BUCKETS",
+           "ENGINE_TRACK"]

@@ -7,6 +7,9 @@ serving dtype, and exposes the continuous-batching engine over HTTP:
     python -m nanosandbox_tpu.serve --out_dir=out --port=8000 &
     curl -s localhost:8000/generate -d '{"prompt": "ROMEO:", \
         "max_new_tokens": 64, "temperature": 0.8, "top_k": 40}'
+    curl -s localhost:8000/metrics            # Prometheus exposition
+    curl -s 'localhost:8000/trace?rid=0'      # Perfetto-loadable trace
+    curl -s localhost:8000/profile -d '{"steps": 50}'   # profiler window
 """
 
 from __future__ import annotations
@@ -123,7 +126,9 @@ def main(argv: list[str] | None = None) -> None:
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
           f"{engine.max_len} ctx; prefill buckets "
           f"{engine.sched.buckets}; listening on "
-          f"{args.host}:{args.port}", file=sys.stderr, flush=True)
+          f"{args.host}:{args.port} (POST /generate, GET /healthz "
+          "/stats /metrics /trace, POST /profile)",
+          file=sys.stderr, flush=True)
     # After a FULL warmup the compile set is complete by contract, so
     # freeze the retrace budgets: a compile after /healthz went green
     # is a shape leak eating a live request's latency, and the engine

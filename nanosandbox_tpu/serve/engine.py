@@ -66,6 +66,9 @@ background thread for concurrent clients.
 from __future__ import annotations
 
 import itertools
+import os
+import tempfile
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -73,8 +76,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from nanosandbox_tpu.obs import MetricRegistry, SpanTracer
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
-from nanosandbox_tpu.utils.metrics import RingStat
+from nanosandbox_tpu.utils import tracecheck as _tracecheck
 from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
 
 
@@ -107,6 +111,7 @@ class _Active:
     tokens: List[int] = field(default_factory=list)
     first_token_t: float = 0.0   # wall clock of the prefill-token readback
     spec_accepted: int = 0       # draft tokens this request accepted
+    span: int = 0                # open "generate" span id (obs tracer)
 
 
 class Engine:
@@ -133,12 +138,24 @@ class Engine:
         synchronous loop (see module docstring); greedy outputs are
         token-identical to spec=None, sampled outputs identically
         distributed.
+    metrics : obs.MetricRegistry to publish on (default: a fresh
+        per-engine registry — tests spin up many engines). Counters and
+        gauges are mirrored from the engine's plain ints by a
+        collection-time callback, so the hot loop never touches them;
+        only the latency histograms observe per event.
+    tracer : obs.SpanTracer recording the span timeline (prefill waves,
+        decode steps with the pipelined one-step-lag retire, spec verify
+        rounds, per-request queued/generate). Default: a fresh bounded
+        tracer; records only already-host-resident ints/floats, so it
+        adds no host sync.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 pipeline: bool = True, spec=None):
+                 pipeline: bool = True, spec=None,
+                 metrics: Optional[MetricRegistry] = None,
+                 tracer: Optional[SpanTracer] = None):
         import jax
         import jax.numpy as jnp
 
@@ -180,22 +197,81 @@ class Engine:
         self._active: Dict[int, _Active] = {}        # slot -> state
         self._pending_results: List[Result] = []     # max_new_tokens == 0
         # The one decode step in flight ahead of the host: (device token
-        # array, {slot: rid} snapshot at dispatch). The snapshot is the
-        # host half of the eviction lag — a slot whose occupant changed
-        # between dispatch and readback drops its ride-along token.
-        self._inflight: Optional[Tuple[object, Dict[int, int]]] = None
+        # array, {slot: rid} snapshot at dispatch, open decode_step span
+        # id). The snapshot is the host half of the eviction lag — a
+        # slot whose occupant changed between dispatch and readback
+        # drops its ride-along token. The span closes at RETIRE, so the
+        # exported timeline shows step k overlapping step k+1's dispatch
+        # — the pipeline's true shape.
+        self._inflight: Optional[Tuple[object, Dict[int, int], int]] = None
         self._rid = itertools.count()
-        self._submit_meta: Dict[int, Tuple[int, float]] = {}  # rid -> (step, t)
+        # rid -> (submit step, submit wall clock, open "queued" span id)
+        self._submit_meta: Dict[int, Tuple[int, float, int]] = {}
         self.steps = 0
         self.admitted = 0
         self.completed = 0
         self.tokens_generated = 0
-        # Latency/throughput observability (bounded rings — /stats must
-        # stay O(1) memory no matter how long the server runs).
-        self._ttft = RingStat(1024)          # submit -> first-token seconds
-        self._tpot = RingStat(1024)          # per-token seconds after first
-        self._queue_wait = RingStat(1024)    # decode steps spent queued
+        # Telemetry spine (nanosandbox_tpu/obs): the latency signal
+        # lives in registry histograms (RingStat window + Prometheus
+        # buckets — /stats and /metrics read the SAME series), counters
+        # and gauges mirror the engine's plain ints at collection time
+        # (zero hot-loop cost), and the tracer records the span
+        # timeline /trace exports.
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self.tracer = tracer if tracer is not None else SpanTracer()
+        m = self.metrics
+        # One engine per registry: re-registration would hand BOTH
+        # engines the same unlabeled families, and their collectors
+        # would silently overwrite each other's mirrored counters at
+        # every scrape. Loud beats last-writer-wins.
+        if any(f.name == "serve_ttft_seconds" for f in m.families()):
+            raise ValueError(
+                "metrics registry already hosts an Engine's families; "
+                "give each Engine its own MetricRegistry")
+        self._ttft = m.histogram(
+            "serve_ttft_seconds", "Submit -> first-token seconds.",
+            unit="seconds")
+        self._tpot = m.histogram(
+            "serve_tpot_seconds", "Per-token seconds after the first.",
+            unit="seconds")
+        self._queue_wait = m.histogram(
+            "serve_queue_wait_steps",
+            "Decode steps a request spent queued before admission.",
+            unit="steps", buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+        self._c_submitted = m.counter(
+            "serve_requests_submitted_total", "Requests accepted by submit().")
+        self._c_completed = m.counter(
+            "serve_requests_completed_total",
+            "Requests finished, by finish reason.", labelnames=("reason",))
+        self._c_waves = m.counter(
+            "serve_prefill_waves_total", "Batched prefill admission waves.")
+        self._c_tokens = m.counter(
+            "serve_tokens_generated_total", "Generated tokens read back.")
+        self._c_steps = m.counter(
+            "serve_decode_steps_total",
+            "Batched decode/verify step dispatches.")
+        self._c_admitted = m.counter(
+            "serve_requests_admitted_total", "Requests admitted to slots.")
+        self._c_traces = m.counter(
+            "serve_compile_traces_total",
+            "Observed jit traces of this engine's programs, by kind.",
+            labelnames=("program",))
+        self._g_active = m.gauge("serve_slots_active",
+                                 "Slots owned by in-flight requests.")
+        self._g_free = m.gauge("serve_slots_free", "Free KV-pool slots.")
+        self._g_queued = m.gauge("serve_queue_depth",
+                                 "Requests queued awaiting admission.")
+        self._g_rate = m.gauge(
+            "serve_decode_tokens_per_sec",
+            "Generated tokens/sec over the recent readback window.")
+        m.add_collector(self._collect_metrics)
         self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
+        # On-demand jax.profiler window (POST /profile): requested from
+        # an HTTP handler thread, opened/advanced/closed by the one
+        # engine-stepping thread inside step().
+        self._profile_lock = threading.Lock()
+        self._profile: Optional[dict] = None
+        self.last_profile: Optional[dict] = None
         # Retrace budgets (utils.tracecheck): jax calls each guarded
         # body once per TRACE, so a shape leak (e.g. a Python scalar
         # specializing a trace) raises CompileBudgetExceeded at the
@@ -220,11 +296,20 @@ class Engine:
                 n_prefill_programs=(len(self.sched.buckets)
                                     * len(self.admit_buckets)),
                 registry=self.tracecheck, on_accel=on_accel)
-        # Acceptance observability (bounded rings, like the latency
-        # signal): per-verify-row accepted lengths and per-request
-        # accepted-token totals.
-        self._spec_accept_len = RingStat(4096)
-        self._spec_req_accepted = RingStat(1024)
+        # Acceptance observability (windowed histograms, like the
+        # latency signal): per-verify-row accepted lengths and
+        # per-request accepted-token totals.
+        self._spec_accept_len = m.histogram(
+            "serve_spec_accept_len",
+            "Accepted draft length per drafting verify row.",
+            unit="tokens", buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16),
+            window=4096)
+        self._spec_req_accepted = m.histogram(
+            "serve_spec_req_accepted_tokens",
+            "Draft tokens accepted over one request's lifetime.",
+            unit="tokens", buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+        if self._spec is not None:
+            self._spec.register_metrics(m)
 
         budget = self.max_programs()
         guard = self.tracecheck.guard
@@ -331,6 +416,21 @@ class Engine:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _collect_metrics(self) -> None:
+        """Collection-time mirror of the engine's plain-int state into
+        the registry — runs per snapshot/scrape, NEVER in the decode
+        loop, which is how telemetry stays off the hot path."""
+        self._c_tokens._set_total(self.tokens_generated)
+        self._c_steps._set_total(self.steps)
+        self._c_admitted._set_total(self.admitted)
+        self._g_active.set(len(self._active))
+        self._g_free.set(self.sched.free_slots)
+        self._g_queued.set(self.sched.queued)
+        rate = self._recent_rate()
+        self._g_rate.set(0.0 if rate is None else rate)
+        for name, n in self.tracecheck.counts().items():
+            self._c_traces.labels(program=name)._set_total(n)
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, eos_id: Optional[int] = None) -> int:
@@ -358,12 +458,20 @@ class Engine:
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), seed=int(seed), eos_id=eos_id)
+        self._c_submitted.inc()
         if max_new_tokens == 0:
+            # Counts as completed too (never reaches _finish): the
+            # natural submitted-minus-completed in-flight alert must
+            # not drift on zero-token requests.
+            self._c_completed.labels(reason="length").inc()
             self._pending_results.append(
                 Result(rid=rid, prompt=prompt, tokens=[],
                        finish_reason="length"))
             return rid
-        self._submit_meta[rid] = (self.steps, time.monotonic())
+        sid = self.tracer.begin("queued", cat="request", rid=rid,
+                                args={"prompt_len": len(prompt),
+                                      "max_new": max_new_tokens})
+        self._submit_meta[rid] = (self.steps, time.monotonic(), sid)
         self.sched.enqueue(req)
         return rid
 
@@ -377,6 +485,12 @@ class Engine:
         the PREVIOUS step's readback (pipelined; with pipeline=False the
         readback is the step just dispatched). Returns the requests that
         finished during this call."""
+        self._profile_window_start()
+        finished = self._step_impl()
+        self._profile_window_advance()
+        return finished
+
+    def _step_impl(self) -> List[Result]:
         finished, self._pending_results = self._pending_results, []
 
         # Backfill free slots mid-flight; a wave finishing on its prefill
@@ -401,7 +515,14 @@ class Engine:
             self.steps += 1
             snapshot = {slot: st.req.rid
                         for slot, st in self._active.items()}
-            prev, self._inflight = self._inflight, (toks, snapshot)
+            # decode_step span: opened at DISPATCH, closed at RETIRE —
+            # under pipelining that close happens after the NEXT step's
+            # open, so the exported timeline shows the true one-step
+            # overlap instead of a synchronous fiction.
+            sid = self.tracer.begin("decode_step", cat="decode",
+                                    args={"step": self.steps,
+                                          "rows": len(snapshot)})
+            prev, self._inflight = self._inflight, (toks, snapshot, sid)
             if not self.pipeline:
                 inflight, self._inflight = self._inflight, None
                 self._retire(inflight, finished)
@@ -429,6 +550,135 @@ class Engine:
         while self.has_work():
             out.extend(self.step())
         return out
+
+    # ------------------------------------------------------------------
+    # on-demand profiling (POST /profile)
+    # ------------------------------------------------------------------
+    def request_profile(self, steps: int, out_dir: Optional[str] = None,
+                        ) -> dict:
+        """Arm a jax.profiler window over the next ``steps`` engine
+        steps (train.py's --profile_steps machinery, serving-side).
+        Thread-safe: HTTP handlers arm it, the one stepping thread
+        opens/advances/closes it inside step(). Freeze-safe by
+        construction — the window only wraps already-compiled programs,
+        so a frozen tracecheck registry stays silent (pinned by test)."""
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"profile steps must be >= 1, got {steps}")
+        with self._profile_lock:
+            if self._profile is not None and self._profile["started"]:
+                raise RuntimeError("a profile window is already in progress")
+            # An armed-but-unstarted window (no traffic arrived yet) is
+            # simply replaced — 409ing on it would wedge /profile
+            # behind a window nothing is profiling, with no way out
+            # until unrelated traffic drains it.
+            self._reap_unstarted_dir()
+            auto = out_dir is None
+            d = out_dir or tempfile.mkdtemp(prefix="serve-profile-")
+            # Validate the (possibly user-supplied) dir HERE, on the
+            # arming thread, where failure is a clean 400 — a bad path
+            # surfacing later inside start_trace on the stepping thread
+            # would kill the whole serving loop for one bad request.
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError as e:
+                raise ValueError(f"unusable profile dir {d!r}: {e}") from e
+            self._profile = {"dir": d, "auto_dir": auto, "steps": steps,
+                             "remaining": steps, "started": False,
+                             "span": 0, "sync_mark": None}
+        return {"dir": d, "steps": steps}
+
+    def _reap_unstarted_dir(self) -> None:
+        """Remove the empty auto-created tempdir of a replaced/cancelled
+        un-started window (call with _profile_lock held) — repeated arms
+        from a flapping prober must not leak one /tmp dir per call.
+        rmdir only: a dir a trace ever wrote into is never touched."""
+        prof = self._profile
+        if prof is not None and prof["auto_dir"] and not prof["started"]:
+            try:
+                os.rmdir(prof["dir"])
+            except OSError:
+                pass
+
+    def cancel_profile(self) -> bool:
+        """Disarm an armed-but-unstarted window (a started one belongs
+        to the stepping thread and runs to its close). Returns whether
+        anything was cancelled."""
+        with self._profile_lock:
+            if self._profile is not None and not self._profile["started"]:
+                self._reap_unstarted_dir()
+                self._profile = None
+                return True
+            return False
+
+    def _profile_window_start(self) -> None:
+        # Unlocked None fast path: this runs EVERY step, and the zero-
+        # hot-loop-cost contract means no mutex traffic unless a window
+        # is actually armed (arming publishes a non-None dict under the
+        # lock; worst case the window starts one step late).
+        if self._profile is None:
+            return
+        # The started flag flips under the lock so cancel/re-arm from
+        # an HTTP thread can never swap the window out between this
+        # check and the trace actually opening.
+        with self._profile_lock:
+            prof = self._profile
+            if prof is None or prof["started"] or not self.has_work():
+                return
+            prof["started"] = True
+        import jax
+
+        try:
+            jax.profiler.start_trace(prof["dir"])
+        except Exception as e:  # dir went bad since arming, profiler busy
+            # Fail the PROFILE, never the serving loop it rides in —
+            # and reap the never-written auto dir, same as cancel.
+            with self._profile_lock:
+                if prof["auto_dir"]:
+                    try:
+                        os.rmdir(prof["dir"])
+                    except OSError:
+                        pass
+                self._profile = None
+            self.last_profile = {"dir": prof["dir"], "steps": prof["steps"],
+                                 "error": f"{type(e).__name__}: {e}"}
+            return
+        prof["sync_mark"] = _tracecheck.sync_counts()
+        prof["span"] = self.tracer.begin(
+            "profile_window", cat="profile",
+            args={"steps": prof["steps"], "dir": prof["dir"]})
+
+    def _profile_window_advance(self) -> None:
+        prof = self._profile
+        if prof is None or not prof["started"]:
+            return
+        prof["remaining"] -= 1
+        # Close early when the engine runs dry: the loop stops stepping
+        # an idle engine, so an N-step window armed during a burst that
+        # drains after k<N steps would otherwise stay open (trace
+        # buffering, /profile 409ing) until traffic returns hours later.
+        if prof["remaining"] > 0 and self.has_work():
+            return
+        import jax
+
+        self.last_profile = {"dir": prof["dir"], "steps": prof["steps"],
+                             "steps_profiled": prof["steps"]
+                             - prof["remaining"]}
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # trace dir reaped, disk full
+            # Same contract as the start side: a stop failure loses the
+            # PROFILE, never the serving loop — and must still clear
+            # the window or /profile would 409 forever.
+            self.last_profile["error"] = f"{type(e).__name__}: {e}"
+            self.tracer.end(prof["span"], {"error": self.last_profile["error"]})
+        else:
+            by_kind = _tracecheck.sync_delta(prof["sync_mark"])
+            self.tracer.end(prof["span"],
+                            {"host_syncs": sum(by_kind.values())})
+            self.last_profile["host_syncs_in_window"] = by_kind
+        with self._profile_lock:
+            self._profile = None
 
     def stats(self) -> dict:
         spec_stats = ({"enabled": False} if self._spec is None
@@ -459,6 +709,8 @@ class Engine:
             "spec_accepted_len_mean": self._spec_accept_len.mean(),
             "spec_req_accepted_tokens": self._spec_req_accepted.percentiles(
                 (50, 90, 99)),
+            "profile": {"active": self._profile is not None,
+                        "last": self.last_profile},
         }
 
     def max_programs(self) -> dict:
@@ -495,6 +747,11 @@ class Engine:
         while (wave := self.sched.next_admission_wave()) is not None:
             reqs, slots, bucket = wave
             k = self.sched.rung_for(len(reqs))
+            self._c_waves.inc()
+            wave_sid = self.tracer.begin(
+                "prefill_wave", cat="prefill",
+                args={"bucket": bucket, "rung": k, "wave": len(reqs),
+                      "rids": [r.rid for r in reqs]})
             # Host staging for the wave — the ONLY host->device uploads
             # the engine performs; the per-token loop stages nothing.
             prompts = np.zeros((k, bucket), np.int32)
@@ -540,15 +797,22 @@ class Engine:
             for i, (req, slot) in enumerate(zip(reqs, slots)):
                 self.admitted += 1
                 self.tokens_generated += 1
-                sub_step, sub_t = self._submit_meta.pop(req.rid)
-                self._queue_wait.record(self.steps - sub_step)
-                self._ttft.record(now - sub_t)
+                sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
+                self._queue_wait.observe(self.steps - sub_step)
+                self._ttft.observe(now - sub_t)
+                self.tracer.end(queued_sid,
+                                {"wait_steps": self.steps - sub_step})
+                gen_sid = self.tracer.begin(
+                    "generate", cat="request", rid=req.rid,
+                    args={"slot": slot, "bucket": bucket})
                 st = _Active(req=req, slot=slot,
-                             tokens=[int(toks_host[i])], first_token_t=now)
+                             tokens=[int(toks_host[i])], first_token_t=now,
+                             span=gen_sid)
                 self._active[slot] = st
                 done = self._maybe_finish(st)
                 if done is not None:
                     finished.append(done)
+            self.tracer.end(wave_sid)
 
     def _spec_step(self, finished: List[Result]) -> None:
         """One speculative round: collect per-row drafts (host prompt
@@ -566,6 +830,9 @@ class Engine:
 
         k = self._spec.k
         drafter = self._spec.drafter
+        verify_sid = self.tracer.begin(
+            "spec_verify", cat="spec",
+            args={"k": k, "rows": len(self._active)})
         caps = {slot: min(k, st.req.max_new_tokens - len(st.tokens) - 1)
                 for slot, st in self._active.items()}
         dl = np.zeros(self.num_slots, np.int32)
@@ -608,7 +875,7 @@ class Engine:
             if dl[slot] > 0:
                 self._spec.drafted += int(dl[slot])
                 self._spec.accepted += acc
-                self._spec_accept_len.record(acc)
+                self._spec_accept_len.observe(acc)
                 st.spec_accepted += acc
             toks = emit_host[slot, :c].tolist()
             if st.req.eos_id is not None and st.req.eos_id in toks:
@@ -623,6 +890,10 @@ class Engine:
                 finished.append(done)
         self.tokens_generated += n_kept
         self._rate_ring.append((now, n_kept))
+        self.tracer.end(verify_sid,
+                        {"emitted": n_kept,
+                         "drafted": int(dl.sum()),
+                         "accepted": int(acc_host.sum())})
 
     def _needs_decode(self) -> bool:
         """False only when every active row's token budget is already
@@ -639,14 +910,14 @@ class Engine:
                 return True
         return False
 
-    def _retire(self, inflight: Tuple[object, Dict[int, int]],
+    def _retire(self, inflight: Tuple[object, Dict[int, int], int],
                 finished: List[Result]) -> None:
         """Read one dispatched step's tokens back and apply the lagged
         finish/eviction decisions. A slot whose occupant is no longer the
         snapshot's rid was evicted after dispatch — its ride-along token
         belongs to nobody and is dropped (the host half of the one-step
         finish lag; the device active mask is the other half)."""
-        toks, snapshot = inflight
+        toks, snapshot, sid = inflight
         # jaxlint: disable=host-sync -- the pipelined readback: one step behind dispatch
         nxt = np.asarray(toks)
         now = time.monotonic()
@@ -662,6 +933,7 @@ class Engine:
                 finished.append(done)
         self.tokens_generated += n_live
         self._rate_ring.append((now, n_live))
+        self.tracer.end(sid, {"live_tokens": n_live})
 
     def _recent_rate(self) -> Optional[float]:
         # list(deque): single C-level copy — stats() may run on an HTTP
@@ -678,15 +950,17 @@ class Engine:
         return toks / (t1 - t0)
 
     def reset_latency_stats(self) -> None:
-        """Clear the TTFT/TPOT/queue-wait/rate rings — benchmarks call
-        this between warmup and the timed workload so the reported
-        percentiles describe the measured traffic, not compile-time."""
-        self._ttft.clear()
-        self._tpot.clear()
-        self._queue_wait.clear()
+        """Clear the TTFT/TPOT/queue-wait/rate windows (and the span
+        ring) — benchmarks call this between warmup and the timed
+        workload so the reported percentiles describe the measured
+        traffic, not compile-time."""
+        self._ttft.reset()
+        self._tpot.reset()
+        self._queue_wait.reset()
         self._rate_ring.clear()
-        self._spec_accept_len.clear()
-        self._spec_req_accepted.clear()
+        self._spec_accept_len.reset()
+        self._spec_req_accepted.reset()
+        self.tracer.clear()
         if self._spec is not None:
             # Acceptance rate should describe the measured workload too —
             # warmup prompts are degenerate (all-zero) and would skew it.
@@ -713,10 +987,13 @@ class Engine:
         self._state = self._release(self._state,
                                     jnp.asarray(state.slot, jnp.int32))
         self.completed += 1
+        self._c_completed.labels(reason=reason).inc()
+        self.tracer.end(state.span, {"tokens": len(state.tokens),
+                                     "finish_reason": reason})
         if self._spec is not None:
-            self._spec_req_accepted.record(state.spec_accepted)
+            self._spec_req_accepted.observe(state.spec_accepted)
         if len(state.tokens) > 1:
-            self._tpot.record((time.monotonic() - state.first_token_t)
-                              / (len(state.tokens) - 1))
+            self._tpot.observe((time.monotonic() - state.first_token_t)
+                               / (len(state.tokens) - 1))
         return Result(rid=req.rid, prompt=req.prompt, tokens=state.tokens,
                       finish_reason=reason)

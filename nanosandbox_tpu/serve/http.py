@@ -17,8 +17,12 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+
+from nanosandbox_tpu.obs import (MetricRegistry, global_registry,
+                                 render_prometheus)
 
 
 class _Pending:
@@ -146,35 +150,133 @@ def make_server(host: str, port: int, loop: EngineLoop,
                      latency signal (decode_tokens_per_sec,
                      queue_wait_steps_mean, ttft_s/tpot_s percentiles)
                      and loop in-flight accounting under "loop"
+    GET  /metrics   -> Prometheus text exposition: the engine's registry
+                     (throughput, TTFT/TPOT, queue depth, compile
+                     traces, spec acceptance), the process-global one
+                     (host-sync/compile ledgers, warn_once firings) and
+                     the loop's in-flight gauges, in one scrape
+    GET  /trace     -> Chrome trace-event JSON (Perfetto-loadable).
+                     ?rid=N: one request's timeline plus the engine
+                     spans overlapping it; ?last_s=S: the trailing S
+                     seconds; no params: the whole span ring
+    POST /profile   {"steps": N} -> arm a jax.profiler window over the
+                     next N engine steps; responds immediately with the
+                     trace dir ({"dir", "steps"}), completion shows up
+                     in /stats under "profile"
     """
+
+    # Loop in-flight accounting as gauges, collected per scrape — the
+    # same numbers /stats carries under "loop", now scrapable.
+    loop_reg = MetricRegistry()
+    g_inbox = loop_reg.gauge("serve_loop_inbox_depth",
+                             "Requests parked in the loop inbox.")
+    g_waiting = loop_reg.gauge("serve_loop_waiting",
+                               "Requests whose waiters are still blocked.")
+    g_dead = loop_reg.gauge("serve_loop_dead",
+                            "1 when the engine loop has died, else 0.")
+
+    def _collect_loop():
+        s = loop.stats()
+        g_inbox.set(s["inbox"])
+        g_waiting.set(s["waiting"])
+        g_dead.set(0.0 if s["dead"] is None else 1.0)
+
+    loop_reg.add_collector(_collect_loop)
 
     class Handler(BaseHTTPRequestHandler):
         def _json(self, code: int, obj: dict) -> None:
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._text(code, json.dumps(obj), "application/json")
 
         def log_message(self, fmt, *args):  # stdout stays metrics-only
             pass
 
+        def _text(self, code: int, body: str, ctype: str) -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
-            if self.path == "/healthz":
+            url = urllib.parse.urlsplit(self.path)
+            if url.path == "/healthz":
                 if loop.dead is not None or not loop.is_alive():
                     self._json(503, {"ok": False,
                                      "error": loop.dead or "loop not running"})
                 else:
                     self._json(200, {"ok": True})
-            elif self.path == "/stats":
+            elif url.path == "/stats":
                 stats = loop.engine.stats()
                 stats["loop"] = loop.stats()
                 self._json(200, stats)
+            elif url.path == "/metrics":
+                try:
+                    body = render_prometheus(loop.engine.metrics,
+                                             global_registry(), loop_reg)
+                except ValueError as e:
+                    # Duplicate family across registries (e.g. an engine
+                    # constructed ON the global registry): a diagnosable
+                    # 500 beats killing every scrape with a dropped
+                    # connection.
+                    self._json(500, {"error": str(e)})
+                    return
+                self._text(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/trace":
+                try:
+                    q = urllib.parse.parse_qs(url.query)
+                    rid = int(q["rid"][0]) if "rid" in q else None
+                    last_s = (float(q["last_s"][0])
+                              if "last_s" in q else None)
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": f"bad query: {e!r}"})
+                    return
+                trace = loop.engine.tracer.export_chrome(rid=rid,
+                                                         last_s=last_s)
+                # In-flight rids export their OPEN spans (duration-so-
+                # far, args.incomplete) — a request stuck in the queue
+                # is visible here, so an empty result really does mean
+                # unknown/rotated.
+                if rid is not None and not trace["traceEvents"]:
+                    self._json(404, {"error": f"no spans for rid {rid} "
+                                              "(unknown id, or rotated "
+                                              "out of the span ring)"})
+                    return
+                self._json(200, trace)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/profile":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            f"body must be a JSON object, got "
+                            f"{type(payload).__name__}")
+                    if payload.get("cancel"):
+                        cancelled = loop.engine.cancel_profile()
+                        self._json(200, {"ok": True,
+                                         "cancelled": cancelled})
+                        return
+                    # No client-supplied dir: this endpoint is
+                    # unauthenticated, and a caller-chosen path would be
+                    # a remote mkdir/file-write primitive inside the pod.
+                    # The engine picks a fresh tempdir; the response
+                    # says where.
+                    steps = int(payload.get("steps", 20))
+                    res = loop.engine.request_profile(steps)
+                except (ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad request: {e!r}"})
+                    return
+                except RuntimeError as e:   # window already in progress
+                    self._json(409, {"error": str(e)})
+                    return
+                self._json(200, {"ok": True, **res})
+                return
             if self.path != "/generate":
                 self._json(404, {"error": f"no route {self.path}"})
                 return

@@ -90,6 +90,38 @@ class SpecRunner:
         self.drafted = 0
         self.accepted = 0
 
+    def register_metrics(self, registry) -> None:
+        """Publish the acceptance ledger on an obs.MetricRegistry — a
+        collection-time mirror of the plain ints above, so the verify
+        loop itself never touches a metric family (the zero-hot-loop
+        telemetry contract). Engine.__init__ calls this with the
+        engine's registry; /metrics then carries the speculative signal
+        a k8s scrape needs to decide whether spec is earning its k."""
+        c_drafted = registry.counter(
+            "serve_spec_tokens_drafted_total",
+            "Draft tokens proposed to the verify step.")
+        c_accepted = registry.counter(
+            "serve_spec_tokens_accepted_total",
+            "Draft tokens the target model accepted.")
+        c_steps = registry.counter(
+            "serve_spec_verify_steps_total", "Batched verify dispatches.")
+        g_rate = registry.gauge(
+            "serve_spec_acceptance_rate",
+            "Token-level accepted/drafted over the process lifetime.")
+
+        def collect():
+            c_drafted._set_total(self.drafted)
+            c_accepted._set_total(self.accepted)
+            c_steps._set_total(self.steps)
+            # Unconditional set: reset_latency_stats() zeros the ledger
+            # after warmup, and a drafted==0 guard would leave the
+            # gauge frozen on the degenerate warmup rate — the exact
+            # skew the reset exists to prevent.
+            g_rate.set(self.accepted / self.drafted if self.drafted
+                       else 0.0)
+
+        registry.add_collector(collect)
+
     # ------------------------------------------------------------------
     def verify(self, params, pool, state, drafts, draft_len):
         """One speculative step over all slots. Returns

@@ -30,6 +30,7 @@ from typing import Any
 import numpy as np
 
 from nanosandbox_tpu.config import GPTConfig, TrainConfig, load_config
+from nanosandbox_tpu.obs import MetricRegistry, SpanTracer
 from nanosandbox_tpu.utils import tracecheck
 
 # Peak bf16 FLOP/s per chip for MFU reporting (public spec-sheet numbers).
@@ -300,6 +301,32 @@ class Trainer:
         # step (the failure mode jaxlint's nonstatic-shape rule hunts
         # statically) and raises instead of silently recompiling.
         self.tracecheck = tracecheck.TraceBudgetRegistry()
+        # Telemetry spine (nanosandbox_tpu/obs): the loss/MFU/tok-s
+        # scalars land on the same registry kind the serve engine
+        # publishes (MetricsWriter keeps owning the JSONL/TB artifact
+        # contract — the registry is the live snapshot view), and the
+        # tracer records eval windows / checkpoint saves / profiler
+        # windows as spans. Only updated at log/eval points, never
+        # inside the compiled step.
+        self.metrics = MetricRegistry()
+        self.tracer = SpanTracer(capacity=2048)
+        m = self.metrics
+        self._m_loss = m.gauge("train_loss",
+                               "Training loss at the last log step.")
+        self._m_grad_norm = m.gauge("train_grad_norm",
+                                    "Global grad norm at the last log step.")
+        self._m_lr = m.gauge("train_lr", "Learning rate at the last "
+                             "log step.")
+        self._m_toks = m.gauge("train_tokens_per_sec",
+                               "Window-averaged training tokens/sec.")
+        self._m_mfu = m.gauge("train_mfu",
+                              "Model FLOPs utilization (0..1).")
+        self._m_iters = m.counter("train_iters_total",
+                                  "Optimizer steps completed.")
+        self._m_eval = m.gauge("eval_loss", "Last estimate_loss value, "
+                               "by split.", labelnames=("split",))
+        self._m_ckpt = m.counter("checkpoint_saves_total",
+                                 "Checkpoints written.")
 
     # -- state ---------------------------------------------------------------
 
@@ -545,6 +572,8 @@ class Trainer:
 
         eval_iters = eval_iters or self.cfg.eval_iters
         _, eval_step = self.compiled_steps()
+        sid = self.tracer.begin("eval", cat="train",
+                                args={"eval_iters": eval_iters})
         out = {}
         for split in ("train", "val"):
             # Build ALL host batches up front, THEN enqueue every eval
@@ -572,6 +601,9 @@ class Trainer:
             # so profiler windows can report their sync count.
             out[split] = tracecheck.host_sync("eval-readback",
                                               jnp.stack(losses).mean())
+            self._m_eval.labels(split=split).set(out[split])
+        self.tracer.end(sid, {f"{k}_loss": round(v, 6)
+                              for k, v in out.items()})
         return out
 
     # -- MFU -----------------------------------------------------------------
@@ -709,10 +741,15 @@ class Trainer:
                     if iter_num > 0 and (losses["val"] < best_val_loss
                                          or cfg.always_save_checkpoint):
                         best_val_loss = min(best_val_loss, losses["val"])
+                        sid = self.tracer.begin("checkpoint_save",
+                                                cat="train",
+                                                args={"iter": iter_num})
                         ckpt.save(iter_num, state,
                                   {"iter_num": iter_num,
                                    "best_val_loss": best_val_loss,
                                    "config": cfg.to_dict()})
+                        self.tracer.end(sid)
+                        self._m_ckpt.inc()
                     if cfg.eval_only:
                         break
                     # Eval + checkpoint time is reported on its own lines;
@@ -727,6 +764,10 @@ class Trainer:
                 if prof_range and iter_num == prof_range[0]:
                     jax.profiler.start_trace(self.profile_dir)
                     self._profiling = True
+                    self._profile_span = self.tracer.begin(
+                        "profiler_window", cat="train",
+                        args={"start": prof_range[0],
+                              "stop": prof_range[1]})
                     # Snapshot the sync ledger so the window report
                     # below describes the TRACED REGION's syncs, not the
                     # process-lifetime totals.
@@ -749,13 +790,10 @@ class Trainer:
                                          metrics["loss"])
                     jax.profiler.stop_trace()
                     self._profiling = False
+                    self.tracer.end(self._profile_span)
                     if self.is_main:
-                        mark = self._profile_sync_mark
-                        by_kind = {
-                            k: v - mark.get(k, 0)
-                            for k, v in tracecheck.sync_counts().items()
-                            if v - mark.get(k, 0) > 0
-                        }
+                        by_kind = tracecheck.sync_delta(
+                            self._profile_sync_mark)
                         print(f"profiler trace for iters "
                               f"[{prof_range[0]}:{prof_range[1]}) -> "
                               f"{self.profile_dir} "
@@ -787,20 +825,34 @@ class Trainer:
                         print(f"iter {iter_num}: loss {loss:.4f}, "
                               f"time {dt * 1000:.2f}ms, "
                               f"tok/s {toks:,.0f}, mfu {mfu * 100:.2f}%")
+                    # jaxlint: disable=host-sync -- free after loss sync
+                    grad_norm = float(metrics["grad_norm"])
+                    lr = (float(self.lr_schedule(iter_num))
+                          if callable(self.lr_schedule)
+                          else self.lr_schedule)
                     writer.log(iter_num, {
                         "train/loss": loss,
-                        # jaxlint: disable=host-sync -- free after loss sync
-                        "train/grad_norm": float(metrics["grad_norm"]),
-                        "train/lr": float(self.lr_schedule(iter_num))
-                        if callable(self.lr_schedule) else self.lr_schedule,
+                        "train/grad_norm": grad_norm,
+                        "train/lr": lr,
                         "perf/tokens_per_sec": toks,
                         "perf/mfu": mfu,
                     })
+                    # The live-snapshot view of the same scalars: the
+                    # registry answers "what is this trainer doing NOW"
+                    # (tests, notebooks, a future scrape) without
+                    # tailing the JSONL artifact.
+                    self._m_loss.set(loss)
+                    self._m_grad_norm.set(grad_norm)
+                    self._m_lr.set(lr)
+                    self._m_toks.set(toks)
+                    self._m_mfu.set(mfu)
+                    self._m_iters._set_total(iter_num + 1)
                 iter_num += 1
         finally:
             if self._profiling:
                 jax.profiler.stop_trace()
                 self._profiling = False
+                self.tracer.end(self._profile_span)
             loader.close()
             writer.close()
 
@@ -809,11 +861,15 @@ class Trainer:
         else:
             losses = self.estimate_loss(state) if cfg.max_iters > 0 else {}
         if cfg.max_iters > 0 and not cfg.eval_only:
+            sid = self.tracer.begin("checkpoint_save", cat="train",
+                                    args={"iter": iter_num, "final": True})
             ckpt.save(iter_num, state,
                       {"iter_num": iter_num,
                        "best_val_loss": min(best_val_loss,
                                             losses.get("val", 1e9)),
                        "config": cfg.to_dict()}, wait=True)
+            self.tracer.end(sid)
+            self._m_ckpt.inc()
         ckpt.close()
         return {"iter_num": iter_num, "final_loss": last_loss, **{
             f"final_{k}_loss": v for k, v in losses.items()}}

@@ -28,11 +28,30 @@ def warn_once(key: str, msg: str) -> None:
     when repeated (e.g. Checkpointer.save skipping an already-saved step
     right after resume): the first occurrence is logged so the run
     doesn't LOOK like it silently stopped doing the thing, repeats stay
-    quiet so a hot loop can't flood the log."""
+    quiet so a hot loop can't flood the log.
+
+    Every firing also lands in the process-global metric registry as
+    ``warn_once_fired_total{key=...}`` — a scrape sees WHICH one-shot
+    conditions a pod hit without anyone tailing stderr."""
     if key in _WARNED_ONCE:
         return
     _WARNED_ONCE.add(key)
+    # Lazy import: obs.registry imports RingStat from this module, so a
+    # top-level import here would be a cycle.
+    from nanosandbox_tpu.obs.registry import global_registry
+    global_registry().counter(
+        "warn_once_fired_total",
+        "One-shot warn_once firings, by dedup key.",
+        labelnames=("key",)).labels(key=key).inc()
     print(msg, file=sys.stderr, flush=True)
+
+
+def reset_for_tests() -> None:
+    """Clear the warn_once dedup registry so tests can assert a warning
+    fires (and fires once) without ordering against every other test
+    that shares the process. The ``warn_once_fired_total`` counter is
+    NOT reset — it is a monotonic process-lifetime ledger."""
+    _WARNED_ONCE.clear()
 
 
 class RingStat:
@@ -95,6 +114,8 @@ class MetricsWriter:
         os.makedirs(self.dir, exist_ok=True)
         self.jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a",
                           buffering=1)
+        self._pending_headers: list[dict[str, Any]] = []
+        self._wrote_any = False
         if tensorboard:
             self.tb = self._make_tb_writer(self.dir)
 
@@ -121,19 +142,33 @@ class MetricsWriter:
             return None
 
     def write_header(self, meta: dict[str, Any]) -> None:
-        """One provenance record at the top of metrics.jsonl — run policy
-        facts a reader needs to interpret the stream but that are not
-        per-step scalars (fixed-eval-batch seed policy, which offset
-        sampler the loader resolved, rng impl). Round-4 VERDICT weak #5/#7:
-        both were undocumented in run artifacts."""
+        """One provenance record for metrics.jsonl — run policy facts a
+        reader needs to interpret the stream but that are not per-step
+        scalars (fixed-eval-batch seed policy, which offset sampler the
+        loader resolved, rng impl). Round-4 VERDICT weak #5/#7: both
+        were undocumented in run artifacts.
+
+        Header-on-first-write: the record is DEFERRED until the first
+        ``log()`` so a run that opens a writer and closes it without
+        logging a single scalar leaves no half-run artifact (a lone
+        header line used to masquerade as a run that produced metrics).
+        If scalars were already written, the header lands immediately —
+        deferring it would only push it further from the top."""
         if not self.enabled or self.jsonl is None:
             return
-        self.jsonl.write(json.dumps({"header": meta,
-                                     "time": time.time()}) + "\n")
+        rec = {"header": meta, "time": time.time()}
+        if self._wrote_any:
+            self.jsonl.write(json.dumps(rec) + "\n")
+        else:
+            self._pending_headers.append(rec)
 
     def log(self, step: int, scalars: dict[str, Any]) -> None:
         if not self.enabled:
             return
+        for rec in self._pending_headers:
+            self.jsonl.write(json.dumps(rec) + "\n")
+        self._pending_headers.clear()
+        self._wrote_any = True
         rec = {"step": step, "time": time.time(), **scalars}
         self.jsonl.write(json.dumps(rec) + "\n")
         if self.tb is not None:

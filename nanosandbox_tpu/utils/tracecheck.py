@@ -37,6 +37,17 @@ from contextlib import contextmanager
 from typing import Callable, Dict, Optional
 
 
+def _obs_counter(metric: str, help: str, name: str):
+    """The ``{name=...}`` child of a process-global counter family —
+    the obs-registry mirror of this module's ledgers. Lazy import:
+    obs.registry is stdlib-only but lives above utils in the package
+    graph, and tracecheck must stay importable on a bare Python."""
+    from nanosandbox_tpu.obs.registry import global_registry
+
+    return global_registry().counter(metric, help,
+                                     labelnames=("name",)).labels(name=name)
+
+
 class CompileBudgetExceeded(RuntimeError):
     """A guarded function traced more often than its declared budget —
     some call-site input (shape, dtype, Python scalar, pytree
@@ -106,6 +117,7 @@ class TraceBudgetRegistry:
         about the real compile set — /stats would overreport programs,
         and assert_within_budget() would fail permanently on an engine
         that survived (and kept serving past) one rejected leak."""
+        n = None
         with self._lock:
             b = self._budgets.setdefault(name, _Budget(name, 0))
             if self._frozen:
@@ -119,7 +131,17 @@ class TraceBudgetRegistry:
                 attempt, budget = b.traces + 1, b.max_traces
             else:
                 b.traces += 1
-                return b.traces
+                n = b.traces
+        if n is not None:
+            # Accepted trace: mirror into the process-global metric
+            # registry so a Prometheus scrape sees compiles process-wide
+            # (per-engine views stay on each engine's own registry).
+            # Compiles are rare by contract, so this is never hot.
+            _obs_counter("compile_traces_total",
+                         "Accepted jit traces, by guarded program name "
+                         "(every budget registry in the process).",
+                         name).inc()
+            return n
         raise CompileBudgetExceeded(
             f"{name!r} would trace {attempt} times, budget {budget}: a "
             "call-site input is specializing the trace (unbucketed "
@@ -197,6 +219,14 @@ def host_sync(name: str, value=None) -> Optional[float]:
     float()/np.asarray in a hot path does get flagged."""
     with _sync_lock:
         _sync_counts[name] = _sync_counts.get(name, 0) + 1
+    # Mirror into the process-global metric registry: /metrics carries
+    # host_syncs_total{name=...} so "did serving start syncing?" is a
+    # scrape query, not a log grep. Deliberate syncs are rare (that is
+    # the point of the ledger), so this path is never hot.
+    _obs_counter("host_syncs_total",
+                 "Deliberate device->host readbacks through the blessed "
+                 "tracecheck.host_sync wrapper, by ledger name.",
+                 name).inc()
     if value is None:
         return None
     return float(value)
@@ -205,6 +235,15 @@ def host_sync(name: str, value=None) -> Optional[float]:
 def sync_counts() -> Dict[str, int]:
     with _sync_lock:
         return dict(_sync_counts)
+
+
+def sync_delta(mark: Dict[str, int]) -> Dict[str, int]:
+    """Per-kind ledger growth since ``mark`` (a prior ``sync_counts()``
+    snapshot), positive entries only — the "how many syncs did this
+    window contain" computation both profiler windows (train.py's
+    --profile_steps and the serve engine's POST /profile) report."""
+    return {k: v - mark.get(k, 0) for k, v in sync_counts().items()
+            if v - mark.get(k, 0) > 0}
 
 
 def sync_count(name: Optional[str] = None) -> int:

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CI smoke for the telemetry spine: start the serve HTTP frontend,
+scrape /metrics, validate the Prometheus exposition with a stdlib
+parser, fetch a /trace export and check its Chrome trace-event schema.
+
+Runs the REAL frontend (EngineLoop + make_server) over a tiny randomly
+initialized model — the wiring under test is the observability surface,
+not the weights — so the scrape exercises exactly the handler, renderer
+and registry path a k8s Prometheus hits in deployment.
+
+    JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Exposition grammar (the subset we emit): HELP/TYPE comments and
+# `name{labels} value` samples — what a scraper's parser accepts.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*"(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+    r'(-?[0-9.eE+-]+|[+-]Inf|NaN)$')
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def validate_exposition(text: str) -> dict[str, str]:
+    """Parse the text format with stdlib only; returns {metric: type}.
+    Raises AssertionError on any line the grammar rejects."""
+    types: dict[str, str] = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("#"):
+            assert _COMMENT_RE.match(ln), f"bad comment line: {ln!r}"
+            parts = ln.split(" ", 3)
+            if parts[1] == "TYPE":
+                assert parts[2] not in types, f"duplicate TYPE {parts[2]}"
+                types[parts[2]] = parts[3]
+        else:
+            assert _SAMPLE_RE.match(ln), f"bad sample line: {ln!r}"
+    assert types, "no TYPE lines in exposition"
+    return types
+
+
+def validate_chrome_trace(trace: dict) -> None:
+    assert set(trace) >= {"traceEvents"}, trace.keys()
+    events = trace["traceEvents"]
+    assert events, "empty traceEvents"
+    for ev in events:
+        assert ev["ph"] in ("X", "M"), ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float))
+            assert ev["dur"] >= 0
+    assert any(ev["ph"] == "X" for ev in events), "no complete events"
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.serve import Engine
+    from nanosandbox_tpu.serve.http import EngineLoop, make_server
+
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=64, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = Engine(model, params, num_slots=4, max_len=64)
+    loop = EngineLoop(engine)
+    loop.start()
+    encode = lambda s: [min(ord(c), cfg.vocab_size - 1) for c in s]  # noqa: E731
+    decode = lambda ids: " ".join(str(i) for i in ids)  # noqa: E731
+    srv = make_server("127.0.0.1", 0, loop, encode, decode)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def get(path: str):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=60) as r:
+            return r.read()
+
+    try:
+        # Traffic first, so the scrape carries real latency samples.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "hello", "max_new_tokens": 8,
+                             "temperature": 0.0}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            gen = json.loads(r.read())
+        assert len(gen["tokens"]) == 8, gen
+        rid = gen["id"]
+
+        text = get("/metrics").decode()
+        types = validate_exposition(text)
+        for required in ("serve_ttft_seconds", "serve_tpot_seconds",
+                         "serve_decode_tokens_per_sec",
+                         "serve_queue_depth", "serve_tokens_generated_total",
+                         "serve_compile_traces_total"):
+            assert required in types, (required, sorted(types))
+        assert types["serve_ttft_seconds"] == "histogram"
+        assert "serve_ttft_seconds_window" in types  # percentile summary
+
+        trace = json.loads(get(f"/trace?rid={rid}"))
+        validate_chrome_trace(trace)
+        names = {ev["name"] for ev in trace["traceEvents"]}
+        assert {"queued", "generate"} <= names, names
+
+        window = json.loads(get("/trace?last_s=600"))
+        validate_chrome_trace(window)
+
+        health = json.loads(get("/healthz"))
+        assert health == {"ok": True}, health
+        print(f"obs smoke OK: {len(types)} metric families, "
+              f"{len(trace['traceEvents'])} trace events for rid {rid}")
+        return 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,55 @@ def test_disabled_writer_is_inert(tmp_path):
     assert not os.listdir(tmp_path)
 
 
+def test_header_deferred_until_first_log(tmp_path):
+    """Regression (ISSUE 5 satellite): a writer that takes a header but
+    is closed without ever logging must leave metrics.jsonl EMPTY — a
+    lone header line used to masquerade as a run that produced
+    metrics."""
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=False)
+    w.write_header({"rng_impl": "rbg"})
+    w.close()
+    assert open(tmp_path / "r" / "metrics.jsonl").read() == ""
+
+
+def test_header_lands_before_first_scalar(tmp_path):
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=False)
+    w.write_header({"rng_impl": "rbg"})
+    w.log(0, {"train/loss": 1.5})
+    w.log(1, {"train/loss": 1.0})
+    w.close()
+    lines = [json.loads(x) for x in open(tmp_path / "r" / "metrics.jsonl")]
+    assert len(lines) == 3
+    assert lines[0]["header"] == {"rng_impl": "rbg"}  # still line 1
+    assert lines[1]["step"] == 0 and lines[2]["step"] == 1
+
+
+def test_multiple_pending_headers_all_land_in_order(tmp_path):
+    """Two provenance records before the first scalar both survive the
+    deferral, in write order — the pending slot must be a queue, not a
+    last-writer-wins cell."""
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=False)
+    w.write_header({"a": 1})
+    w.write_header({"b": 2})
+    w.log(0, {"x": 0.5})
+    w.close()
+    lines = [json.loads(x) for x in open(tmp_path / "r" / "metrics.jsonl")]
+    assert [ln.get("header", {"step": True})
+            for ln in lines] == [{"a": 1}, {"b": 2}, {"step": True}]
+
+
+def test_header_after_scalars_writes_immediately(tmp_path):
+    """A late header (scalars already flowing) appends in stream order
+    — deferring it would only push it further from the top."""
+    w = MetricsWriter(str(tmp_path), run_name="r", tensorboard=False)
+    w.log(0, {"train/loss": 2.0})
+    w.write_header({"note": "late"})
+    w.close()
+    lines = [json.loads(x) for x in open(tmp_path / "r" / "metrics.jsonl")]
+    assert [("step" in ln, "header" in ln) for ln in lines] == \
+        [(True, False), (False, True)]
+
+
 def test_warn_once_dedupes_by_key(capsys):
     from nanosandbox_tpu.utils.metrics import warn_once
 
@@ -71,6 +120,32 @@ def test_warn_once_dedupes_by_key(capsys):
     assert err.count("message A") == 1
     assert "again" not in err
     assert "message B" in err
+
+
+def test_warn_once_reset_for_tests_and_counter_family(capsys):
+    """ISSUE 5 satellite: the dedup registry is resettable so tests can
+    assert a warning fires without ordering against the whole process,
+    and every firing lands as warn_once_fired_total{key=...} in the
+    process-global metric registry (which reset does NOT clear — it is
+    a monotonic process-lifetime ledger)."""
+    from nanosandbox_tpu.obs import global_registry
+    from nanosandbox_tpu.utils.metrics import reset_for_tests, warn_once
+
+    def fired(key):
+        snap = global_registry().snapshot()
+        return sum(s["value"]
+                   for s in snap["warn_once_fired_total"]["series"]
+                   if s["labels"]["key"] == key)
+
+    warn_once("test-metrics-reset-key", "once")
+    warn_once("test-metrics-reset-key", "suppressed")
+    assert fired("test-metrics-reset-key") == 1
+    reset_for_tests()
+    warn_once("test-metrics-reset-key", "fires again after reset")
+    err = capsys.readouterr().err
+    assert err.count("once") == 1 and "suppressed" not in err
+    assert "fires again after reset" in err
+    assert fired("test-metrics-reset-key") == 2
 
 
 def test_ring_stat_percentiles_and_bound():
