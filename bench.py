@@ -274,8 +274,10 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "spec_tokens_generated": gen_total["spec"],
         })
 
+    from nanosandbox_tpu.analysis.shardcheck import provenance
+
     sync_rate = median(rates["sync"])
-    obs_extra = {}
+    obs_extra = {"provenance": provenance()}
     if _flag(kv, "emit_obs"):
         # --emit_obs: attach the full metric-registry snapshots (plus
         # the process-global ledgers) so a bench artifact carries the
@@ -360,12 +362,18 @@ def main(argv: list[str]) -> dict:
     m = measure_train_throughput(cfg, warmup, iters)
     toks_per_chip = m["tokens_per_sec_per_chip"]
 
+    from nanosandbox_tpu.analysis.shardcheck import provenance
+
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
         else "tiny_train_tokens_per_sec_per_chip_cpu",
         "value": toks_per_chip,
         "unit": "tokens/sec/chip",
         "vs_baseline": round(toks_per_chip / A10_BASELINE_TOKS_PER_SEC, 3),
+        # jax/jaxlib + device kind/count: cross-run perf/comms
+        # comparisons (BENCH_rNN.json trend lines) are attributable to
+        # the runtime that produced them.
+        "provenance": provenance(),
         "extra": {
             "backend": jax.default_backend(),
             "n_chips": n_chips,

@@ -21,6 +21,19 @@ Rules (see docs/playbook.md "Static analysis" for the full catalogue):
                    donate_argnums without an accelerator guard
   impure-trace     np.random/time/global-state mutation inside
                    jit-traced functions (side effects replay per trace)
+  unconstrained-output  jit with in_shardings but no out_shardings and
+                   no with_sharding_constraint in the traced closure
+  implicit-replication  device_put without an explicit sharding in a
+                   mesh-aware module
+  axis-mismatch    PartitionSpec axis names outside the registered
+                   mesh axis set (parallel.mesh.AXES)
+
+The IR-level half lives one package down:
+``python -m nanosandbox_tpu.analysis shardcheck`` (analysis/shardcheck/)
+AOT-lowers the compiled-program fleet under a declared mesh, extracts
+every collective from the optimized HLO with bytes + mesh axes, flags
+accidental communication, and pins the result against the committed
+``budgets/*.json`` in CI.
 
 Suppress a deliberate violation with a REASONED comment (the reason is
 mandatory; a bare disable is itself a finding)::

@@ -1,23 +1,84 @@
 """CLI: ``python -m nanosandbox_tpu.analysis [options] <paths>``.
 
-Exit status is the CI gate: 0 clean, 1 findings, 2 usage error. The
-JSON report (``--format=json``, optionally ``--out=FILE`` so CI can
-upload it as an artifact while the text summary still lands in the
-log) is schema-versioned — see docs/playbook.md "Static analysis".
+Two tools, one entry point:
+
+  * jaxlint (default) — the jax-free AST linter. Exit status is the CI
+    gate: 0 clean, 1 findings, 2 usage error. The JSON report
+    (``--format=json``, optionally ``--out=FILE`` so CI can upload it
+    as an artifact while the text summary still lands in the log) is
+    schema-versioned — see docs/playbook.md "Static analysis".
+  * ``shardcheck`` subcommand — the IR-level comms analyzer
+    (``python -m nanosandbox_tpu.analysis shardcheck --help``); this
+    one compiles programs and therefore imports jax. See
+    docs/playbook.md "Sharding analysis".
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 
 
+def changed_only_paths(paths, base: str, cwd=None):
+    """Resolve the lint set from ``git diff --name-only <base>`` —
+    staged + unstaged changes vs the base commit, the fast pre-commit
+    path (CI keeps the full run). Returns the changed .py files that
+    live under one of ``paths``. Untracked files are invisible to
+    ``git diff``; ``git add`` them first (as a pre-commit run has)."""
+    from pathlib import Path
+
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", base],
+        capture_output=True, text=True, cwd=cwd)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base} failed: "
+            f"{proc.stderr.strip() or 'not a git checkout?'}")
+    # git prints REPO-ROOT-relative paths regardless of where it ran;
+    # resolving them against the cwd would silently drop every changed
+    # file when invoked from a subdirectory.
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True, cwd=cwd)
+    if top.returncode != 0:
+        raise RuntimeError("git rev-parse --show-toplevel failed: "
+                           f"{top.stderr.strip()}")
+    root_dir = Path(top.stdout.strip())
+    base_dir = Path(cwd) if cwd else Path.cwd()
+    roots = [(base_dir / p).resolve() for p in paths]
+    missing = [str(p) for p, r in zip(paths, roots) if not r.exists()]
+    if missing:
+        # A root that resolves to nothing (e.g. the default
+        # 'nanosandbox_tpu' run from a subdirectory) must fail loudly
+        # like the plain run does — not degrade into an empty changed
+        # set and a green exit.
+        raise RuntimeError(
+            f"path(s) {missing} do not exist relative to {base_dir}")
+    out = []
+    for line in proc.stdout.splitlines():
+        f = root_dir / line.strip()
+        if not f.suffix == ".py" or not f.exists():
+            continue           # deleted files have nothing to lint
+        r = f.resolve()
+        if any(r == root or root in r.parents for root in roots):
+            out.append(str(f))
+    return out
+
+
 def main(argv=None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if argv and argv[0] == "shardcheck":
+        from nanosandbox_tpu.analysis.shardcheck.cli import main as sc_main
+
+        return sc_main(argv[1:])
+
     ap = argparse.ArgumentParser(
         prog="python -m nanosandbox_tpu.analysis",
         description="jaxlint: static analysis for the stack's JAX/TPU "
                     "invariants (host syncs, tracer leaks, shape "
-                    "bucketing, donation, trace purity)")
+                    "bucketing, donation, trace purity, sharding "
+                    "annotations). For the IR-level comms analyzer run "
+                    "the `shardcheck` subcommand.")
     ap.add_argument("paths", nargs="*", default=["nanosandbox_tpu"],
                     help="files or directories to lint "
                          "(default: nanosandbox_tpu)")
@@ -29,7 +90,18 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
-    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs --base (from "
+                         "`git diff --name-only`) — the fast pre-commit "
+                         "run; CI keeps the full tree")
+    ap.add_argument("--base", default="HEAD", metavar="REF",
+                    help="git ref --changed-only diffs against "
+                         "(default: HEAD)")
+    ap.add_argument("--strict-suppressions", action="store_true",
+                    help="a reasoned suppression that no longer matches "
+                         "any finding becomes a finding itself (rot "
+                         "gate)")
+    args = ap.parse_args(argv)
 
     from nanosandbox_tpu.analysis.core import (all_rules, analyze_paths,
                                                render_json, render_text)
@@ -39,15 +111,28 @@ def main(argv=None) -> int:
             print(f"{rid}: {rule.doc}")
         return 0
 
+    paths = args.paths
+    if args.changed_only:
+        try:
+            paths = changed_only_paths(args.paths, args.base)
+        except RuntimeError as e:
+            print(f"jaxlint: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"jaxlint: no changed Python files vs {args.base} "
+                  f"under {args.paths!r} — nothing to lint")
+            return 0
+
     select = ([r.strip() for r in args.select.split(",") if r.strip()]
               if args.select else None)
     try:
-        report = analyze_paths(args.paths, select=select)
+        report = analyze_paths(paths, select=select,
+                               strict_suppressions=args.strict_suppressions)
     except ValueError as e:
         print(f"jaxlint: {e}", file=sys.stderr)
         return 2
     if report["summary"]["files_scanned"] == 0:
-        print(f"jaxlint: no Python files under {args.paths!r}",
+        print(f"jaxlint: no Python files under {paths!r}",
               file=sys.stderr)
         return 2
 

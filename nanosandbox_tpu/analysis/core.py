@@ -22,7 +22,9 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 JSON_SCHEMA_VERSION = 1
 
-# `# jaxlint: disable=host-sync,tracer-leak -- why this is deliberate`
+# Syntax: `jaxlint: disable=host-sync,tracer-leak -- why this is
+# deliberate` after a `#` (spelled without the leading hash here so the
+# unused-suppression check doesn't see this very comment as one).
 _SUPPRESS_RE = re.compile(
     r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*?))?\s*$")
 
@@ -106,7 +108,8 @@ def _load_builtin_rules() -> None:
         return
     _BUILTINS_LOADED = True
     from nanosandbox_tpu.analysis import (rules_donation,  # noqa: F401
-                                          rules_sync, rules_tracer)
+                                          rules_sharding, rules_sync,
+                                          rules_tracer)
 
 
 # ---------------------------------------------------------------- suppression
@@ -156,11 +159,21 @@ def _suppression_for(sup: List[Suppression], finding: Finding,
 
 def analyze_source(source: str, path: str = "<string>",
                    select: Optional[Sequence[str]] = None,
+                   strict_suppressions: bool = False,
                    ) -> Tuple[List[Finding], int]:
     """Lint one source string. Returns (findings, suppressed_count).
 
     ``select`` restricts to a subset of rule ids (the fixture tests use
     it to pin each rule to its known-bad twin in isolation).
+
+    Unused suppressions: a REASONED disable whose line no longer
+    triggers any of its rules has rotted — the audited violation is
+    gone but the audit comment still vouches for one. They are always
+    collected (``unused_suppressions`` in the report, notes in the text
+    render); ``strict_suppressions`` promotes them to findings so CI
+    can refuse the rot outright. Under ``select`` the check only
+    applies to suppressions naming a selected rule — the others never
+    got a chance to match.
     """
     from nanosandbox_tpu.analysis.jitscope import ModuleIndex
 
@@ -202,7 +215,8 @@ def analyze_source(source: str, path: str = "<string>",
     # Malformed suppressions are findings whether or not they matched
     # anything — a typo'd rule id or a bare disable must not sit inert
     # while the author believes the violation is audited.
-    known = set(all_rules()) | {"all", "parse-error", "bad-suppression"}
+    known = set(all_rules()) | {"all", "parse-error", "bad-suppression",
+                                "unused-suppression"}
     for s in suppressions:
         if not s.reason:
             findings.append(Finding(
@@ -215,7 +229,38 @@ def analyze_source(source: str, path: str = "<string>",
                     path, s.line, 0, "bad-suppression",
                     f"unknown rule id {r!r} in suppression — known: "
                     f"{', '.join(sorted(set(all_rules())))}"))
+        # Unused reasoned suppressions (the rot check): only judged
+        # when every rule it names actually ran this pass — and a
+        # `disable=all` only under a FULL run (any unselected rule
+        # could be what it suppresses).
+        if (s.reason and not s.used
+                and (select is None
+                     or ("all" not in s.rules
+                         and all(r in select for r in s.rules)))):
+            _UNUSED_LOG.append({
+                "file": path, "line": s.line,
+                "rules": list(s.rules), "reason": s.reason})
+            if strict_suppressions:
+                findings.append(Finding(
+                    path, s.line, 0, "unused-suppression",
+                    f"suppression for {', '.join(s.rules)} no longer "
+                    "matches any finding — the audited violation is "
+                    "gone; delete the comment (reason was: "
+                    f"{s.reason!r})"))
     return sorted(set(findings), key=lambda f: f.key()), suppressed
+
+
+# analyze_source appends here so analyze_paths can report unused
+# suppressions without changing the (findings, suppressed) signature
+# every caller and test pins; single-threaded like the rest of the CLI.
+_UNUSED_LOG: List[dict] = []
+
+
+def drain_unused_suppressions() -> List[dict]:
+    """Take (and clear) the unused-suppression records accumulated by
+    analyze_source calls since the last drain."""
+    out, _UNUSED_LOG[:] = list(_UNUSED_LOG), []
+    return out
 
 
 def iter_python_files(paths: Sequence[str]) -> List[Path]:
@@ -238,10 +283,12 @@ def iter_python_files(paths: Sequence[str]) -> List[Path]:
 
 
 def analyze_paths(paths: Sequence[str],
-                  select: Optional[Sequence[str]] = None) -> dict:
+                  select: Optional[Sequence[str]] = None,
+                  strict_suppressions: bool = False) -> dict:
     """Lint files/directories; returns the report dict render_json dumps."""
     findings: List[Finding] = []
     suppressed = 0
+    drain_unused_suppressions()
     files = iter_python_files(paths)
     for f in files:
         try:
@@ -250,7 +297,8 @@ def analyze_paths(paths: Sequence[str],
             findings.append(Finding(str(f), 1, 0, "parse-error",
                                     f"could not read: {e}"))
             continue
-        fs, sup = analyze_source(src, str(f), select=select)
+        fs, sup = analyze_source(src, str(f), select=select,
+                                 strict_suppressions=strict_suppressions)
         findings.extend(fs)
         suppressed += sup
     by_rule: Dict[str, int] = {}
@@ -260,6 +308,7 @@ def analyze_paths(paths: Sequence[str],
         "version": JSON_SCHEMA_VERSION,
         "tool": "jaxlint",
         "findings": [vars(f) for f in findings],
+        "unused_suppressions": drain_unused_suppressions(),
         "summary": {
             "files_scanned": len(files),
             "findings": len(findings),
@@ -274,10 +323,17 @@ def analyze_paths(paths: Sequence[str],
 def render_text(report: dict) -> str:
     lines = [f"{f['file']}:{f['line']}:{f['col']}: {f['rule']}: "
              f"{f['message']}" for f in report["findings"]]
+    unused = report.get("unused_suppressions", [])
+    lines.extend(
+        f"{u['file']}:{u['line']}: note: unused suppression for "
+        f"{', '.join(u['rules'])} (use --strict-suppressions to fail "
+        "on these)" for u in unused)
     s = report["summary"]
     lines.append(f"jaxlint: {s['findings']} finding(s) in "
                  f"{s['files_scanned']} file(s), "
-                 f"{s['suppressed']} suppressed")
+                 f"{s['suppressed']} suppressed"
+                 + (f", {len(unused)} unused suppression(s)" if unused
+                    else ""))
     return "\n".join(lines)
 
 

@@ -318,6 +318,16 @@ class ModuleIndex:
 
     # ------------------------------------------------------------- utilities
 
+    def traced_closure(self, expr: ast.AST,
+                       enclosing: Optional[FunctionInfo]) -> Set[str]:
+        """Qualnames of every in-module function reachable from the
+        function-valued expression ``expr`` (e.g. the first argument of
+        a ``jax.jit`` call), closed transitively — the public form of
+        the root-resolution the index itself uses, for rules that need
+        to inspect a specific traced closure (rules_sharding)."""
+        return self._close_over(self._fn_refs(expr, enclosing),
+                                lambda name: True)
+
     def hot_scope(self) -> Set[str]:
         """Functions where a host sync is a finding: traced bodies plus
         the host loops that drive compiled programs."""

@@ -1,0 +1,170 @@
+"""Sharding-annotation rules: the AST-side feeders for shardcheck.
+
+shardcheck (analysis/shardcheck/) finds the collectives XLA actually
+inserted; these three rules catch the ANNOTATION mistakes that cause
+them, at the source level, before anything compiles:
+
+  unconstrained-output   a ``jax.jit`` that declares ``in_shardings``
+                         but neither declares ``out_shardings`` nor
+                         calls ``with_sharding_constraint`` anywhere in
+                         the traced closure — the partitioner is free
+                         to pick the output layout, and "free" is how a
+                         mesh-sized result quietly comes back
+                         replicated (the frontier_slice fixture's
+                         all-gather is this rule's runtime twin).
+  implicit-replication   ``jax.device_put(x)`` with no
+                         sharding/device argument in a module that
+                         works with meshes: the value lands REPLICATED
+                         (or on one device), and the first compiled
+                         consumer pays a reshard — placement in
+                         multi-device paths must be spelled out.
+  axis-mismatch          a ``PartitionSpec``/``P(...)`` naming an axis
+                         outside the registered mesh axis set
+                         (data/fsdp/seq/model — parallel/mesh.py
+                         ``AXES``): GSPMD treats an unknown name as
+                         just another axis label until mesh-bind time,
+                         when it fails far from the typo (or worse,
+                         a stale name silently stops sharding).
+
+Like every jaxlint rule this file is pure ast — the axis registry is
+MIRRORED here (jaxlint must run without jax installed) and a test pins
+the mirror against ``parallel.mesh.AXES``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from nanosandbox_tpu.analysis.core import (Finding, ModuleContext, Rule,
+                                           register)
+from nanosandbox_tpu.analysis.jitscope import dotted_name, terminal_name
+
+# Mirror of parallel.mesh.AXES (jax-free by design; pinned by
+# tests/test_analysis.py against the real registry).
+REGISTERED_AXIS_NAMES = ("data", "fsdp", "seq", "model")
+
+
+def _jit_call_nodes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and terminal_name(node.func) == "jit":
+            yield node
+
+
+def _kw(call: ast.Call, name: str):
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+@register
+class UnconstrainedOutputRule(Rule):
+    id = "unconstrained-output"
+    doc = ("jax.jit with in_shardings but no out_shardings and no "
+           "with_sharding_constraint in the traced closure — the "
+           "partitioner freely picks the output layout, which is how "
+           "mesh-sized results come back replicated (accidental "
+           "all-gathers)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        idx = ctx.index
+        for call in _jit_call_nodes(ctx.tree):
+            if _kw(call, "in_shardings") is None:
+                continue       # meshless jit: nothing declared to lose
+            if _kw(call, "out_shardings") is not None:
+                continue
+            enclosing = idx.enclosing_function(call.lineno)
+            closure: Set[str] = set()
+            for arg in call.args[:1]:
+                closure |= idx.traced_closure(arg, enclosing)
+            constrained = False
+            for qual in closure:
+                info = idx.functions.get(qual)
+                if info is None:
+                    continue
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Call) and terminal_name(
+                            node.func) == "with_sharding_constraint":
+                        constrained = True
+                        break
+                if constrained:
+                    break
+            if not constrained:
+                out.append(Finding(
+                    ctx.path, call.lineno, call.col_offset, self.id,
+                    "jit declares in_shardings but neither out_shardings "
+                    "nor any with_sharding_constraint in the traced "
+                    "closure — pin the output layout (or constrain the "
+                    "intermediate) so the partitioner cannot replicate "
+                    "a mesh-sized result behind your back"))
+        return out
+
+
+@register
+class ImplicitReplicationRule(Rule):
+    id = "implicit-replication"
+    doc = ("jax.device_put without a sharding/device argument in a "
+           "mesh-aware module — the value lands replicated or "
+           "single-device and the first sharded consumer pays a "
+           "reshard; spell the placement out")
+
+    _MESH_MARKERS = ("NamedSharding", "make_mesh", "make_hybrid_mesh",
+                     "Mesh(")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        # Only mesh-aware modules: a single-chip script's device_put has
+        # exactly one sensible placement and naming it would be noise.
+        if not any(m in ctx.source for m in self._MESH_MARKERS):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in ("jax.device_put", "device_put"):
+                continue
+            has_placement = len(node.args) >= 2 or any(
+                k.arg in ("device", "sharding") for k in node.keywords)
+            if not has_placement:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    "device_put without an explicit sharding in a "
+                    "mesh-aware module — this lands the value "
+                    "replicated/single-device and the first sharded "
+                    "consumer pays the reshard; pass a NamedSharding"))
+        return out
+
+
+@register
+class AxisMismatchRule(Rule):
+    id = "axis-mismatch"
+    doc = ("PartitionSpec axis names outside the registered mesh axis "
+           "set (parallel.mesh.AXES: data/fsdp/seq/model) — unknown "
+           "names fail at mesh-bind time far from the typo, or "
+           "silently stop sharding")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        registered = set(REGISTERED_AXIS_NAMES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in ("P", "PartitionSpec"):
+                continue
+            for arg in node.args:
+                entries = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                           else [arg])
+                for e in entries:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str) \
+                            and e.value not in registered:
+                        out.append(Finding(
+                            ctx.path, e.lineno, e.col_offset, self.id,
+                            f"PartitionSpec names axis {e.value!r}, not "
+                            "in the registered mesh axis set "
+                            f"{REGISTERED_AXIS_NAMES} "
+                            "(parallel.mesh.AXES)"))
+        return out

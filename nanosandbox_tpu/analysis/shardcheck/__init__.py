@@ -1,0 +1,39 @@
+"""shardcheck — IR-level sharding & collective-communication analysis.
+
+jaxlint (the rest of ``nanosandbox_tpu.analysis``) reads SOURCE; this
+subpackage reads what XLA actually DECIDED: it AOT-lowers every
+compiled program in the fleet under a declared mesh, walks the
+optimized (post-GSPMD) HLO for collectives — kind, operand/result
+bytes, mesh axes recovered from replica groups — and emits a
+per-program comms manifest. On top of the manifest sit a rule layer
+for *accidental* communication (an all-gather materializing a tensor
+that had a NamedSharding, collectives in a declared comms-free decode
+step, non-all-reduce traffic on the data axis, resharding at a
+donation boundary) and a budget layer that pins the manifest in CI the
+way tracecheck pins retrace counts.
+
+    python -m nanosandbox_tpu.analysis shardcheck \
+        --fleet=train --budget=budgets/train_cpu8.json
+
+Layout: hlo.py (jax-free HLO text grammar), manifest.py (axis
+attribution + ProgramSpec + analyzer), rules.py (accident rules),
+budget.py (jax-free pin/check), fleet.py (the committed program
+fleets + the frontier_slice fixture pair), cli.py (the subcommand).
+Program enumeration lives WITH the owners: ``Trainer`` /
+``Engine`` / ``SpecRunner`` / ``ModelDrafter`` each export
+``shardcheck_programs()``.
+"""
+
+from nanosandbox_tpu.analysis.shardcheck.budget import (budget_from_manifest,
+                                                        check_budget,
+                                                        load_budget,
+                                                        write_budget)
+from nanosandbox_tpu.analysis.shardcheck.manifest import (
+    Expectations, ProgramSpec, analyze_program, axis_groups,
+    build_manifest, export_manifest_metrics, provenance,
+    render_manifest_text)
+
+__all__ = ["Expectations", "ProgramSpec", "analyze_program", "axis_groups",
+           "build_manifest", "render_manifest_text", "provenance",
+           "export_manifest_metrics", "budget_from_manifest",
+           "check_budget", "load_budget", "write_budget"]

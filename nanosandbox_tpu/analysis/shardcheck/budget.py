@@ -1,0 +1,151 @@
+"""CI-pinned comms budgets: the TraceBudgetRegistry move, one level down.
+
+A budget file freezes a fleet's manifest the way tracecheck freezes
+trace counts: each program's (collective kind, mesh axes) entries with
+their counts and bytes. The check fails on anything that GREW — a new
+program nobody budgeted, a new (kind, axes) pair, a count increase, or
+bytes up by more than the file's tolerance — while shrinkage is
+reported as a stale note (ratchet down by regenerating, see
+``scripts/update_shardcheck_budgets.sh``). This makes "this program now
+moves 3x more bytes over ICI" a red CI check a PR must answer for,
+instead of a mystery MULTICHIP regression two rounds later; ROADMAP
+item 1's TP-serving work must rewrite the serve budget EXPLICITLY.
+
+Pure stdlib on dicts: the budget tests run without jax, mirroring
+hlo.py's grammar tests.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+BUDGET_SCHEMA_VERSION = 1
+DEFAULT_TOLERANCE = 0.10
+
+
+def budget_from_manifest(manifest: Dict[str, Any],
+                         tolerance: float = DEFAULT_TOLERANCE,
+                         ) -> Dict[str, Any]:
+    programs: Dict[str, Any] = {}
+    for name, entry in manifest["programs"].items():
+        programs[name] = {
+            key: {"kind": slot["kind"], "axes": list(slot["axes"]),
+                  "count": int(slot["count"]),
+                  "bytes": int(slot["bytes_moved"])}
+            for key, slot in entry["collectives"].items()
+        }
+    return {
+        "version": BUDGET_SCHEMA_VERSION,
+        "tool": "shardcheck",
+        "tolerance_bytes_frac": tolerance,
+        "provenance": manifest.get("provenance", {}),
+        "mesh": manifest.get("mesh", {}),
+        "programs": programs,
+    }
+
+
+def check_budget(manifest: Dict[str, Any], budget: Dict[str, Any],
+                 ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """(violations, stale_notes). Violations fail CI; stale notes mean
+    the live fleet communicates LESS than budgeted (regenerate to
+    ratchet down) or the environment changed (provenance drift)."""
+    violations: List[Dict[str, Any]] = []
+    notes: List[str] = []
+    tol = float(budget.get("tolerance_bytes_frac", DEFAULT_TOLERANCE))
+
+    if budget.get("mesh") and manifest.get("mesh") \
+            and budget["mesh"] != manifest["mesh"]:
+        violations.append({
+            "kind": "mesh-mismatch", "program": None,
+            "message": f"budget pinned mesh {budget['mesh']} but the "
+                       f"manifest ran on {manifest['mesh']} — budgets "
+                       "are per-mesh contracts"})
+        return violations, notes
+
+    bp = budget.get("provenance", {})
+    mp = manifest.get("provenance", {})
+    for k in ("jax", "jaxlib"):
+        if bp.get(k) and mp.get(k) and bp[k] != mp[k]:
+            notes.append(f"provenance drift: budget pinned {k} {bp[k]}, "
+                         f"running {mp[k]} — partitioner decisions may "
+                         "differ; regenerate if the check fails")
+
+    b_programs = budget.get("programs", {})
+    m_programs = manifest.get("programs", {})
+    for name in sorted(set(m_programs) - set(b_programs)):
+        violations.append({
+            "kind": "unbudgeted-program", "program": name,
+            "message": f"program `{name}` is not in the budget — every "
+                       "compiled program in the fleet must be pinned "
+                       "(regenerate with --write-budget to adopt it "
+                       "deliberately)"})
+    for name in sorted(set(b_programs) - set(m_programs)):
+        violations.append({
+            "kind": "missing-program", "program": name,
+            "message": f"budgeted program `{name}` is gone from the "
+                       "fleet — removing a program is a contract change; "
+                       "regenerate the budget explicitly"})
+
+    for name in sorted(set(b_programs) & set(m_programs)):
+        b_entry = b_programs[name]
+        m_entry = m_programs[name]["collectives"]
+        for key in sorted(set(m_entry) - set(b_entry)):
+            slot = m_entry[key]
+            violations.append({
+                "kind": "new-collective", "program": name,
+                "message": f"`{name}` grew a new collective "
+                           f"{slot['kind']} on "
+                           f"[{'+'.join(slot['axes']) or 'none'}] "
+                           f"({slot['count']}x, {slot['bytes_moved']} "
+                           "bytes) not in the budget"})
+        for key in sorted(set(b_entry) - set(m_entry)):
+            notes.append(f"stale: `{name}` no longer emits {key} "
+                         "(budget can ratchet down)")
+        for key in sorted(set(b_entry) & set(m_entry)):
+            b_slot, m_slot = b_entry[key], m_entry[key]
+            if m_slot["count"] > b_slot["count"]:
+                violations.append({
+                    "kind": "count-growth", "program": name,
+                    "message": f"`{name}` {key}: {m_slot['count']} "
+                               f"instances vs budgeted "
+                               f"{b_slot['count']}"})
+            elif m_slot["count"] < b_slot["count"]:
+                notes.append(f"stale: `{name}` {key} count "
+                             f"{m_slot['count']} < budgeted "
+                             f"{b_slot['count']}")
+            limit = b_slot["bytes"] * (1.0 + tol)
+            if m_slot["bytes_moved"] > limit:
+                violations.append({
+                    "kind": "bytes-growth", "program": name,
+                    "message": f"`{name}` {key}: {m_slot['bytes_moved']} "
+                               f"bytes moved vs budgeted "
+                               f"{b_slot['bytes']} "
+                               f"(+{tol:.0%} tolerance = "
+                               f"{int(limit)})"})
+            elif m_slot["bytes_moved"] < b_slot["bytes"] * (1.0 - tol):
+                # A budget left far above the live number is silently
+                # loose — a later regression back up would stay green.
+                notes.append(f"stale: `{name}` {key} moves "
+                             f"{m_slot['bytes_moved']} bytes, well under "
+                             f"the budgeted {b_slot['bytes']} (ratchet "
+                             "down by regenerating)")
+    return violations, notes
+
+
+def load_budget(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        budget = json.load(f)
+    if budget.get("tool") != "shardcheck":
+        raise ValueError(f"{path} is not a shardcheck budget file")
+    if budget.get("version") != BUDGET_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path} has budget schema version {budget.get('version')}, "
+            f"this tool speaks {BUDGET_SCHEMA_VERSION}")
+    return budget
+
+
+def write_budget(path: str, budget: Dict[str, Any]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(budget, f, indent=1, sort_keys=False)
+        f.write("\n")

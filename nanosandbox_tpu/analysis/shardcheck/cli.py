@@ -1,0 +1,145 @@
+"""``python -m nanosandbox_tpu.analysis shardcheck`` — the CLI driver.
+
+Exit status mirrors jaxlint's: 0 clean, 1 findings or budget
+violations, 2 usage error. Unlike jaxlint this half of the analysis
+package DOES import jax (it compiles programs); the subcommand
+bootstraps its own virtual device fleet (JAX_PLATFORMS=cpu +
+--xla_force_host_platform_device_count) BEFORE the first jax import,
+exactly like tests/conftest.py, so it runs identically on a laptop and
+in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+
+
+def _bootstrap_devices(n: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # REPLACE any pre-existing device-count flag (a stale N=2 from a
+    # README repro session would otherwise win and surface as an
+    # opaque mesh-reshape crash deep inside jax) — same scrub the
+    # dryrun bootstrap applies.
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; caller chose the platform
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nanosandbox_tpu.analysis shardcheck",
+        description="shardcheck: AOT-lower the compiled-program fleet "
+                    "under a declared mesh, extract every collective "
+                    "with bytes + mesh axes, flag accidental "
+                    "communication, and pin the result against a CI "
+                    "comms budget")
+    ap.add_argument("--fleet", default="train,serve",
+                    help="comma-separated fleets to analyze "
+                         "(train, serve; default: both)")
+    ap.add_argument("--mesh", default="1,2,2,2", metavar="DP,FSDP,SP,TP",
+                    help="mesh axis sizes (default 1,2,2,2 over 8 "
+                         "virtual CPU devices)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual CPU device count (default: the mesh "
+                         "size)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the report to FILE (CI uploads it "
+                         "as the shardcheck-manifest artifact)")
+    ap.add_argument("--budget", default=None, metavar="FILE",
+                    help="check the manifest against this pinned budget "
+                         "(any new collective / count growth / bytes "
+                         "growth past its tolerance exits 1)")
+    ap.add_argument("--write-budget", default=None, metavar="FILE",
+                    help="write a fresh budget pinning this manifest "
+                         "(the explicit ratchet/adopt step)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="bytes tolerance fraction for --write-budget "
+                         "(default 0.10)")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    try:
+        mesh_spec = tuple(int(x) for x in args.mesh.split(","))
+        if len(mesh_spec) != 4 or any(m < 1 for m in mesh_spec):
+            raise ValueError
+    except ValueError:
+        print(f"shardcheck: bad --mesh {args.mesh!r} (want DP,FSDP,SP,TP)",
+              file=sys.stderr)
+        return 2
+    fleets = [f.strip() for f in args.fleet.split(",") if f.strip()]
+
+    _bootstrap_devices(args.devices or math.prod(mesh_spec))
+
+    from nanosandbox_tpu.analysis.shardcheck import budget as budget_mod
+    from nanosandbox_tpu.analysis.shardcheck.fleet import (FLEETS,
+                                                           build_mesh,
+                                                           fleet_programs)
+    from nanosandbox_tpu.analysis.shardcheck.manifest import (
+        build_manifest, render_manifest_text)
+
+    unknown = sorted(set(fleets) - set(FLEETS))
+    if unknown:
+        print(f"shardcheck: unknown fleet(s) {', '.join(unknown)}; "
+              f"known: {', '.join(FLEETS)}", file=sys.stderr)
+        return 2
+
+    mesh = build_mesh(mesh_spec)
+    specs = []
+    for fleet in fleets:
+        specs.extend(fleet_programs(fleet, mesh))
+    manifest = build_manifest(
+        specs, mesh,
+        progress=lambda name: print(f"shardcheck: lowering {name} ...",
+                                    file=sys.stderr))
+
+    failed = bool(manifest["findings"])
+    if args.budget:
+        try:
+            budget = budget_mod.load_budget(args.budget)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"shardcheck: cannot load budget {args.budget}: {e}",
+                  file=sys.stderr)
+            return 2
+        violations, notes = budget_mod.check_budget(manifest, budget)
+        manifest["budget"] = {"file": args.budget,
+                              "violations": violations, "notes": notes}
+        failed = failed or bool(violations)
+
+    rendered = (json.dumps(manifest, indent=1) if args.format == "json"
+                else render_manifest_text(manifest))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(rendered + "\n")
+        print(render_manifest_text(manifest))
+    else:
+        print(rendered)
+    if args.budget:
+        for note in manifest["budget"]["notes"]:
+            print(f"shardcheck: note: {note}")
+        for v in manifest["budget"]["violations"]:
+            print(f"shardcheck: BUDGET VIOLATION [{v['kind']}] "
+                  f"{v['message']}")
+        if not manifest["budget"]["violations"]:
+            print(f"shardcheck: budget {args.budget} OK")
+
+    if args.write_budget:
+        tol = (args.tolerance if args.tolerance is not None
+               else budget_mod.DEFAULT_TOLERANCE)
+        budget_mod.write_budget(
+            args.write_budget,
+            budget_mod.budget_from_manifest(manifest, tolerance=tol))
+        print(f"shardcheck: wrote budget {args.write_budget}")
+
+    return 1 if failed else 0

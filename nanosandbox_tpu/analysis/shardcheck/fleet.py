@@ -1,0 +1,165 @@
+"""The analyzed program fleets + the known-bad fixture pair.
+
+Two committed fleets, one per budget file:
+
+  * ``train`` — a dryrun-shaped tiny Trainer (2L/64d, the
+    __graft_entry__ mesh factoring dp x fsdp x sp x tp) exercising
+    every parallelism axis: ZeRO-3 param gathers, ring attention
+    permutes, TP activation collectives. Budget:
+    ``budgets/train_cpu8.json``.
+  * ``serve`` — a tiny Engine with a ModelDrafter: decode, the
+    prefill ladder x bucket grid, spec verify, drafter draft +
+    draft_prefill grid, everything REPLICATED on the mesh (today's
+    single-chip contract stated explicitly) so the budget pins zero
+    collectives. Budget: ``budgets/serve_cpu8.json``.
+
+``frontier_slice_programs`` is the proof fixture: a decode-frontier
+gather (``dynamic_slice`` at a traced offset) over a row-sharded pool.
+The constrained twin reshards OFF the sliced dim first
+(``with_sharding_constraint``) and lowers to a bounded all-to-all; the
+unconstrained twin silently all-gathers the ENTIRE pool on every
+device — the exact accident class shardcheck exists to catch, pinned
+by tests/test_shardcheck.py with nonzero byte attribution.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+from typing import List, Tuple
+
+DEFAULT_MESH = (1, 2, 2, 2)          # (dp, fsdp, sp, tp) over 8 devices
+FLEETS = ("train", "serve")
+
+
+def build_mesh(mesh_spec: Tuple[int, int, int, int] = DEFAULT_MESH):
+    from nanosandbox_tpu.parallel.mesh import make_mesh
+
+    dp, fsdp, sp, tp = mesh_spec
+    return make_mesh(dp, fsdp, tp, sp)
+
+
+def train_programs(mesh) -> List:
+    """Tiny-Trainer train/eval ProgramSpecs on ``mesh`` (the dryrun
+    shapes: ring+dropout+rbg+remat live so the analyzed compile surface
+    is the one the production long-context configs ship)."""
+    from nanosandbox_tpu.config import TrainConfig
+    from nanosandbox_tpu.data.prepare import prepare_char_dataset
+    from nanosandbox_tpu.parallel.mesh import axis_sizes
+    from nanosandbox_tpu.train import Trainer
+
+    sizes = axis_sizes(mesh)
+    tmp = tempfile.mkdtemp(prefix="shardcheck_train_")
+    # The ProgramSpecs close over the Trainer (lazy .lower()), so the
+    # dataset must outlive this call — reap at process exit instead of
+    # leaking one synthetic-corpus dir per analysis run.
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+    data_dir = os.path.join(tmp, "data")
+    prepare_char_dataset(os.path.join(data_dir, "shakespeare_char"),
+                         allow_synthetic=True,
+                         url="http://invalid.localhost/offline")
+    cfg = TrainConfig(
+        out_dir=os.path.join(tmp, "out"), data_dir=data_dir,
+        dataset="shakespeare_char",
+        n_layer=2, n_head=4, n_embd=64, block_size=64,
+        batch_size=2 * mesh.devices.size, gradient_accumulation_steps=2,
+        max_iters=1, eval_interval=0, log_interval=1,
+        warmup_iters=1, lr_decay_iters=1,
+        dropout=0.1, compute_dtype="float32",
+        mesh_dp=sizes["data"], mesh_fsdp=sizes["fsdp"],
+        mesh_tp=sizes["model"], mesh_sp=sizes["seq"],
+        attention_impl="ring" if sizes["seq"] > 1 else "auto",
+        rng_impl="rbg", shard_params=sizes["fsdp"] > 1, remat=True,
+        tensorboard=False, device="auto")
+    trainer = Trainer(cfg, mesh_devices=list(mesh.devices.flat))
+    return trainer.shardcheck_programs()
+
+
+def serve_programs(mesh) -> List:
+    """Tiny-Engine ProgramSpecs (decode + prefill grid + spec verify +
+    ModelDrafter draft/draft_prefill) on ``mesh``, all replicated."""
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.serve.drafters import ModelDrafter
+    from nanosandbox_tpu.serve.engine import Engine
+
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=64,
+                    vocab_size=256, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    dcfg = GPTConfig(n_layer=1, n_head=2, n_embd=32, block_size=64,
+                     vocab_size=256, dropout=0.0, compute_dtype="float32",
+                     attention_impl="xla")
+    dmodel = GPT(dcfg)
+    dparams = dmodel.init(jax.random.key(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = Engine(model, params, num_slots=4, max_len=32,
+                    prefill_buckets=(16, 32),
+                    spec=ModelDrafter(dmodel, dparams, k=3))
+    return engine.shardcheck_programs(mesh)
+
+
+def frontier_slice_programs(mesh, constrained: bool) -> List:
+    """The fixture pair (see module docstring). ``constrained=False``
+    drops the with_sharding_constraint — the injected accident."""
+    import jax
+    import jax.numpy as jnp
+    from jax.lax import dynamic_slice_in_dim, with_sharding_constraint
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nanosandbox_tpu.analysis.shardcheck.manifest import (Expectations,
+                                                              ProgramSpec)
+
+    rep = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P("fsdp", None))
+    pool = jax.ShapeDtypeStruct((256, 64), jnp.float32,
+                                sharding=row_sharded)
+    start = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+
+    def frontier_good(pool, start):
+        # Reshard OFF the sliced dim before the traced-offset slice:
+        # each device then owns full rows and the exchange is a bounded
+        # all-to-all instead of a full materialization.
+        pool = with_sharding_constraint(
+            pool, NamedSharding(mesh, P(None, "fsdp")))
+        return dynamic_slice_in_dim(pool, start, 8, axis=0)
+
+    def frontier_bad(pool, start):
+        # The dropped constraint: a traced-offset dynamic_slice on the
+        # sharded dim forces GSPMD to all-gather the ENTIRE pool.
+        return dynamic_slice_in_dim(pool, start, 8, axis=0)
+
+    if constrained:
+        name = "frontier_slice"
+
+        def lower():
+            return jax.jit(frontier_good,
+                           in_shardings=(row_sharded, rep)).lower(pool,
+                                                                  start)
+    else:
+        name = "frontier_slice_unconstrained"
+
+        def lower():
+            # jaxlint: disable=unconstrained-output -- the deliberate bad twin the acceptance test pins
+            return jax.jit(frontier_bad,
+                           in_shardings=(row_sharded, rep)).lower(pool,
+                                                                  start)
+
+    return [ProgramSpec(name=name, lower=lower,
+                        abstract_args=(pool, start),
+                        expect=Expectations(), tags=("fixture",))]
+
+
+def fleet_programs(fleet: str, mesh) -> List:
+    if fleet == "train":
+        return train_programs(mesh)
+    if fleet == "serve":
+        return serve_programs(mesh)
+    raise ValueError(f"unknown fleet {fleet!r}; known: {FLEETS}")

@@ -1,0 +1,372 @@
+"""shardcheck manifest: AOT-lower a program fleet, attribute collectives.
+
+The pipeline per program: ``ProgramSpec.lower()`` (an AOT
+``jax.jit(...).lower(...)``) -> ``compile()`` -> optimized HLO text ->
+``hlo.parse_hlo_collectives`` -> replica groups mapped back to MESH AXES
+(``axis_groups`` below) -> aggregated per (kind, axes) with the byte
+convention ``Collective.bytes_moved`` documents -> one manifest dict the
+budget layer (budget.py) pins and the rule layer (rules.py) judges.
+
+Axis attribution: a replica group set like ``{{0,2},{1,3},{4,6},{5,7}}``
+is exactly "the device positions that vary the ``fsdp`` coordinate with
+everything else fixed" for some mesh — so each group set is matched
+against the group sets of every non-trivial axis subset of the declared
+mesh (positions = indices into ``mesh.devices.flat``, which is what
+XLA's flattened device assignment numbers). collective-permute carries
+source/target pairs instead; those are attributed to the single axis
+whose coordinate every pair steps along.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from nanosandbox_tpu.analysis.shardcheck.hlo import (Collective,
+                                                     parse_hlo_collectives)
+
+MANIFEST_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Expectations:
+    """Per-program declarations the rule layer judges the manifest
+    against. The defaults assume nothing; a program that SHOULD
+    communicate must say where (and a comms-free one must say so)."""
+    comms_free: bool = False          # any collective at all is a finding
+    gather_ok_axes: Tuple[str, ...] = ()   # full-input gathers expected here
+    allreduce_only_axes: Tuple[str, ...] = ()  # only all-reduce allowed here
+    max_axis_allreduces: Optional[int] = None  # fusion bound on those axes
+    donated_flat_args: Tuple[int, ...] = ()    # flattened donated positions
+
+
+@dataclass
+class ProgramSpec:
+    """One compiled program of the fleet: a name, a lazy AOT lower, the
+    abstract args (their ``.sharding`` attributes drive the sharded /
+    replicated byte accounting), and the expectations."""
+    name: str
+    lower: Callable[[], Any]          # () -> jax.stages.Lowered
+    abstract_args: Tuple[Any, ...] = ()
+    expect: Expectations = field(default_factory=Expectations)
+    tags: Tuple[str, ...] = ()
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    # Delegates to the mesh-side helper (lazily: this module must stay
+    # importable without jax) so budget keys and attribution can never
+    # diverge from the mesh's own flattening semantics.
+    from nanosandbox_tpu.parallel.mesh import axis_sizes
+
+    return axis_sizes(mesh)
+
+
+def axis_groups(axis_sizes: Dict[str, int],
+                ) -> List[Tuple[Tuple[str, ...],
+                                FrozenSet[FrozenSet[int]]]]:
+    """(axes subset, replica-group set) for every non-trivial subset of
+    mesh axes, smallest subsets first so a match reports the MINIMAL
+    axis set (size-1 axes add nothing and are excluded). Positions are
+    flat indices into the mesh's device array — the numbering XLA's
+    device assignment uses for a jit over that mesh."""
+    names = [n for n, s in axis_sizes.items() if s > 1]
+    sizes = [axis_sizes[n] for n in axis_sizes]
+    total = math.prod(sizes) if sizes else 1
+    all_names = list(axis_sizes)
+    # coordinate strides in the flattened order
+    strides = {}
+    acc = 1
+    for n in reversed(all_names):
+        strides[n] = acc
+        acc *= axis_sizes[n]
+    out = []
+    for r in range(1, len(names) + 1):
+        for subset in itertools.combinations(names, r):
+            fixed = [n for n in all_names if n not in subset]
+            groups = set()
+            fixed_ranges = [range(axis_sizes[n]) for n in fixed]
+            sub_ranges = [range(axis_sizes[n]) for n in subset]
+            for fixed_coords in itertools.product(*fixed_ranges):
+                base = sum(c * strides[n]
+                           for c, n in zip(fixed_coords, fixed))
+                groups.add(frozenset(
+                    base + sum(c * strides[n]
+                               for c, n in zip(sub_coords, subset))
+                    for sub_coords in itertools.product(*sub_ranges)))
+            out.append((subset, frozenset(groups)))
+    assert all(len(g) * len(next(iter(g))) == total
+               for _, g in out if g), "axis group cover must partition"
+    return out
+
+
+def _axis_coords(axis_sizes: Dict[str, int], pos: int) -> Dict[str, int]:
+    coords = {}
+    for n in reversed(list(axis_sizes)):
+        coords[n] = pos % axis_sizes[n]
+        pos //= axis_sizes[n]
+    return coords
+
+
+def attribute_axes(coll: Collective, axis_sizes: Dict[str, int],
+                   groups_index) -> Tuple[str, ...]:
+    """Mesh axes a collective communicates over; ("unknown",) when the
+    group structure matches no axis subset (e.g. a hand-rolled group)."""
+    if coll.groups is not None:
+        # Groups of size 1 move nothing across devices.
+        if all(len(g) == 1 for g in coll.groups):
+            return ()
+        for axes, gset in groups_index:
+            if coll.groups == gset:
+                return axes
+        return ("unknown",)
+    if coll.pairs:
+        stepped: set = set()
+        for src, dst in coll.pairs:
+            cs, cd = (_axis_coords(axis_sizes, src),
+                      _axis_coords(axis_sizes, dst))
+            diff = tuple(n for n in axis_sizes if cs[n] != cd[n])
+            if not diff:
+                continue
+            stepped.add(diff)
+        if not stepped:
+            return ()
+        if len(stepped) == 1:
+            return next(iter(stepped))
+        return ("unknown",)
+    return ("unknown",)
+
+
+def agg_key(kind: str, axes: Tuple[str, ...]) -> str:
+    return f"{kind}|{'+'.join(axes) if axes else 'none'}"
+
+
+def _leaf_entries(abstract_args) -> List[Tuple[str, Any]]:
+    import jax
+
+    leaves = []
+    for i, arg in enumerate(abstract_args):
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for path, leaf in flat:
+            name = f"arg{i}" + "".join(str(p) for p in path)
+            leaves.append((name, leaf))
+    return leaves
+
+
+def _input_byte_split(abstract_args, axis_sizes) -> Dict[str, Any]:
+    """Replicated vs sharded input accounting from the declared
+    shardings: full bytes of replicated leaves, per-device bytes of
+    sharded ones, and the {full bytes -> leaf name} index the
+    accidental-all-gather rule matches gathers against."""
+    import numpy as np
+
+    replicated = 0
+    sharded_per_device = 0
+    # Byte size -> ALL sharded leaves of that size: matching a gather
+    # back to "which input" by byte count is a heuristic, and
+    # same-shaped leaves (per-layer kernels) are the common case — a
+    # finding must name every candidate, not just the first.
+    sharded_full: Dict[int, List[str]] = {}
+    for name, leaf in _leaf_entries(abstract_args):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        axes = [a for entry in (spec or ()) if entry
+                for a in ((entry,) if isinstance(entry, str) else entry)]
+        shards = math.prod(axis_sizes.get(a, 1) for a in axes)
+        if shards <= 1:
+            replicated += nbytes
+        else:
+            sharded_per_device += nbytes // shards
+            sharded_full.setdefault(nbytes, []).append(name)
+    return {"replicated_input_bytes": replicated,
+            "sharded_input_bytes_per_device": sharded_per_device,
+            "sharded_input_full_bytes": sharded_full}
+
+
+def analyze_program(spec: ProgramSpec, mesh) -> Dict[str, Any]:
+    """Compile one ProgramSpec and return its manifest entry."""
+    axis_sizes = mesh_axis_sizes(mesh)
+    groups_index = axis_groups(axis_sizes)
+    compiled = spec.lower().compile()
+    parsed = parse_hlo_collectives(compiled.as_text())
+
+    split = _input_byte_split(spec.abstract_args, axis_sizes)
+    sharded_full = split.pop("sharded_input_full_bytes")
+
+    agg: Dict[str, Dict[str, int]] = {}
+    full_gathers: List[Dict[str, Any]] = []
+    donated_comms: List[Dict[str, Any]] = []
+    for coll in parsed.collectives:
+        axes = attribute_axes(coll, axis_sizes, groups_index)
+        key = agg_key(coll.kind, axes)
+        slot = agg.setdefault(key, {"kind": coll.kind,
+                                    "axes": list(axes), "count": 0,
+                                    "bytes_moved": 0, "max_bytes_out": 0})
+        slot["count"] += 1
+        slot["bytes_moved"] += coll.bytes_moved
+        slot["max_bytes_out"] = max(slot["max_bytes_out"], coll.bytes_out)
+        if coll.kind == "all-gather" and coll.bytes_out in sharded_full:
+            candidates = sharded_full[coll.bytes_out]
+            full_gathers.append({
+                "axes": list(axes), "bytes": coll.bytes_out,
+                # Size-match heuristic: one candidate is an attribution,
+                # several are a shortlist (and a same-sized unrelated
+                # intermediate can false-match — gather_ok_axes is the
+                # knob for declaring those expected).
+                "materializes": (candidates[0] if len(candidates) == 1
+                                 else f"one of {candidates}"),
+                "candidates": list(candidates),
+                "instr": coll.name})
+        if coll.operand_params:
+            donated = sorted(set(coll.operand_params)
+                             & set(spec.expect.donated_flat_args))
+            if donated:
+                donated_comms.append({
+                    "kind": coll.kind, "axes": list(axes),
+                    "bytes": coll.bytes_moved, "params": donated})
+
+    by_axis: Dict[str, int] = {}
+    for slot in agg.values():
+        for a in (slot["axes"] or ["none"]):
+            by_axis[a] = by_axis.get(a, 0) + slot["bytes_moved"]
+
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            mem = {"argument_bytes": int(ma.argument_size_in_bytes),
+                   "output_bytes": int(ma.output_size_in_bytes),
+                   "temp_bytes": int(ma.temp_size_in_bytes),
+                   "alias_bytes": int(ma.alias_size_in_bytes)}
+    except Exception:          # backends without buffer assignment info
+        mem = {}
+
+    return {
+        "collectives": {k: agg[k] for k in sorted(agg)},
+        "totals": {
+            "count": sum(s["count"] for s in agg.values()),
+            "bytes_moved": sum(s["bytes_moved"] for s in agg.values()),
+            "by_axis": dict(sorted(by_axis.items())),
+        },
+        "full_input_gathers": full_gathers,
+        "donated_param_comms": donated_comms,
+        **split,
+        "memory": mem,
+    }
+
+
+def provenance() -> Dict[str, Any]:
+    """jax/jaxlib versions + device kind/count: the attribution block
+    every comms/perf artifact (manifest, BENCH, MULTICHIP) carries so
+    cross-run comparisons know what produced them."""
+    import jax
+    import jaxlib
+
+    devices = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": len(devices),
+    }
+
+
+def build_manifest(specs: List[ProgramSpec], mesh,
+                   progress: Optional[Callable[[str], None]] = None,
+                   ) -> Dict[str, Any]:
+    from nanosandbox_tpu.analysis.shardcheck.rules import check_program
+
+    programs: Dict[str, Any] = {}
+    findings: List[Dict[str, Any]] = []
+    for spec in specs:
+        if progress:
+            progress(spec.name)
+        entry = analyze_program(spec, mesh)
+        entry["findings"] = check_program(spec.name, entry, spec.expect)
+        findings.extend(entry["findings"])
+        programs[spec.name] = entry
+    return {
+        "version": MANIFEST_SCHEMA_VERSION,
+        "tool": "shardcheck",
+        "provenance": provenance(),
+        "mesh": mesh_axis_sizes(mesh),
+        "programs": programs,
+        "findings": findings,
+        "summary": {
+            "programs": len(programs),
+            "collectives_total": sum(
+                p["totals"]["count"] for p in programs.values()),
+            "bytes_moved_total": sum(
+                p["totals"]["bytes_moved"] for p in programs.values()),
+            "findings": len(findings),
+        },
+    }
+
+
+def render_manifest_text(manifest: Dict[str, Any]) -> str:
+    """The human table: one line per (program, kind, axes)."""
+    lines = []
+    mesh = "x".join(f"{k}={v}" for k, v in manifest["mesh"].items())
+    prov = manifest["provenance"]
+    lines.append(f"shardcheck: mesh {mesh} on {prov['device_count']}x "
+                 f"{prov['device_kind']} (jax {prov['jax']})")
+    header = (f"{'program':<24} {'collective':<20} {'axes':<12} "
+              f"{'count':>5} {'bytes':>12}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, entry in manifest["programs"].items():
+        if not entry["collectives"]:
+            lines.append(f"{name:<24} {'(comms-free)':<20} {'-':<12} "
+                         f"{0:>5} {0:>12}")
+        for slot in entry["collectives"].values():
+            axes = "+".join(slot["axes"]) or "none"
+            lines.append(f"{name:<24} {slot['kind']:<20} {axes:<12} "
+                         f"{slot['count']:>5} {slot['bytes_moved']:>12}")
+    for f in manifest["findings"]:
+        lines.append(f"FINDING [{f['rule']}] {f['program']}: {f['message']}")
+    s = manifest["summary"]
+    lines.append(f"shardcheck: {s['programs']} program(s), "
+                 f"{s['collectives_total']} collective(s), "
+                 f"{s['bytes_moved_total']} bytes moved, "
+                 f"{s['findings']} finding(s)")
+    return "\n".join(lines)
+
+
+def export_manifest_metrics(manifest_or_budget: Dict[str, Any],
+                            registry) -> None:
+    """Publish per-program collective counts as
+    ``shardcheck_collectives_total{program=,kind=}`` gauges on an
+    obs.MetricRegistry — the serve frontend calls this at startup with
+    the committed budget so a /metrics scrape carries the comms
+    contract the engine is currently running under."""
+    g = registry.gauge(
+        "shardcheck_collectives_total",
+        "Pinned collective count per compiled program (shardcheck).",
+        labelnames=("program", "kind"))
+    gb = registry.gauge(
+        "shardcheck_bytes_moved_total",
+        "Pinned bytes moved per compiled program (shardcheck).",
+        labelnames=("program",))
+    for name, entry in manifest_or_budget.get("programs", {}).items():
+        # A manifest entry wraps its table in "collectives"; a budget
+        # entry IS the table.
+        table = entry.get("collectives", entry) if isinstance(entry, dict) \
+            else {}
+        by_kind: Dict[str, int] = {}
+        total_bytes = 0
+        for slot in table.values():
+            by_kind[slot["kind"]] = by_kind.get(slot["kind"], 0) \
+                + int(slot["count"])
+            total_bytes += int(slot.get("bytes_moved", slot.get("bytes", 0)))
+        if not by_kind:
+            g.labels(program=name, kind="none").set(0)
+        for kind, count in sorted(by_kind.items()):
+            g.labels(program=name, kind=kind).set(count)
+        gb.labels(program=name).set(total_bytes)

@@ -1,0 +1,120 @@
+"""Manifest rules: the *accidental*-communication findings.
+
+The budget layer (budget.py) pins how much a program communicates; this
+layer judges WHAT it communicates against the program's declared
+Expectations. Four rules, each a structural accident class:
+
+  comms-free-violation     any collective in a program declared
+                           comms-free — the serve engine's decode /
+                           prefill / verify programs run replicated
+                           today, so a dp-axis collective appearing in
+                           one means an annotation leaked or a future
+                           TP change forgot to update the declaration.
+  accidental-all-gather    an all-gather materializing the FULL global
+                           bytes of an input that had a non-replicated
+                           NamedSharding, on an axis where full gathers
+                           are not expected (fsdp's ZeRO-3 param
+                           gathers ARE expected — declared via
+                           gather_ok_axes). This is the "dropped
+                           with_sharding_constraint" signature: GSPMD
+                           could not keep the value sharded and quietly
+                           rebuilt the whole tensor on every device.
+  unexpected-dp-collective a gather/scatter/permute on an axis declared
+                           all-reduce-only (the data axis: gradient
+                           sync is the ONLY traffic that should ride
+                           it; anything else means batch-dim sharding
+                           broke inside the step).
+  unfused-grad-allreduce   more all-reduce instances on the
+                           all-reduce-only axes than the declared
+                           fusion bound — per-leaf gradient reductions
+                           that XLA failed to combine serialize the
+                           interconnect with launch latency.
+  donated-reshard          a collective consuming a donated argument
+                           directly: the donation aliased the buffer,
+                           and resharding it at the call boundary buys
+                           a copy exactly where the donation promised
+                           none.
+
+Rules are pure functions of (manifest entry, Expectations) so the unit
+tests feed synthetic manifests — no compile in the loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from nanosandbox_tpu.analysis.shardcheck.manifest import Expectations
+
+
+def _finding(program: str, rule: str, message: str,
+             bytes_: int = 0) -> Dict[str, Any]:
+    return {"program": program, "rule": rule, "message": message,
+            "bytes": int(bytes_)}
+
+
+def check_program(name: str, entry: Dict[str, Any],
+                  expect: Expectations) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    colls = entry.get("collectives", {})
+
+    if expect.comms_free and colls:
+        for slot in colls.values():
+            axes = "+".join(slot["axes"]) or "none"
+            out.append(_finding(
+                name, "comms-free-violation",
+                f"{slot['count']}x {slot['kind']} on axes [{axes}] moving "
+                f"{slot['bytes_moved']} bytes in a program declared "
+                "comms-free — an annotation leaked (or the declaration "
+                "is stale: update the program's Expectations AND its "
+                "budget explicitly)", slot["bytes_moved"]))
+
+    ok_gather = set(expect.gather_ok_axes)
+    for fg in entry.get("full_input_gathers", ()):
+        axes = set(fg["axes"])
+        if axes and axes <= ok_gather:
+            continue
+        out.append(_finding(
+            name, "accidental-all-gather",
+            f"all-gather on axes [{'+'.join(fg['axes']) or 'none'}] "
+            f"materializes the full {fg['bytes']} bytes of sharded input "
+            f"`{fg['materializes']}` — a NamedSharding was declared but "
+            "the program rebuilds the whole tensor on every device "
+            "(typical cause: a dropped with_sharding_constraint, or an "
+            "op like a traced-offset dynamic_slice on the sharded dim)",
+            fg["bytes"]))
+
+    ar_only = set(expect.allreduce_only_axes)
+    if ar_only:
+        n_ar = 0
+        for slot in colls.values():
+            axes = set(slot["axes"])
+            if not (axes & ar_only):
+                continue
+            if slot["kind"] != "all-reduce":
+                out.append(_finding(
+                    name, "unexpected-dp-collective",
+                    f"{slot['count']}x {slot['kind']} on "
+                    f"[{'+'.join(slot['axes'])}] — this axis is declared "
+                    "all-reduce-only (gradient sync); any other "
+                    "collective there means batch-dim sharding broke "
+                    "inside the step", slot["bytes_moved"]))
+            else:
+                n_ar += slot["count"]
+        if expect.max_axis_allreduces is not None \
+                and n_ar > expect.max_axis_allreduces:
+            out.append(_finding(
+                name, "unfused-grad-allreduce",
+                f"{n_ar} all-reduce instances on "
+                f"[{'+'.join(sorted(ar_only))}] exceed the declared "
+                f"fusion bound {expect.max_axis_allreduces} — per-leaf "
+                "gradient reductions are not being combined"))
+
+    for dc in entry.get("donated_param_comms", ()):
+        out.append(_finding(
+            name, "donated-reshard",
+            f"{dc['kind']} on [{'+'.join(dc['axes']) or 'none'}] consumes "
+            f"donated argument(s) {dc['params']} directly — the donation "
+            "aliased this buffer, and resharding it at the call boundary "
+            "costs the copy the donation was supposed to save",
+            dc["bytes"]))
+    return out

@@ -23,7 +23,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("data", "fsdp", "seq", "model")
 
+# The axis-name registry: every PartitionSpec in the stack may only name
+# these axes. jaxlint's `axis-mismatch` rule enforces the same set
+# statically (analysis/rules_sharding.py mirrors it — jax-free — and a
+# test pins the two in sync), and sharding.spec_for_param validates it
+# at runtime.
+REGISTERED_AXES = frozenset(AXES)
+
 _CURRENT_MESH: Mesh | None = None
+
+
+def axis_sizes(mesh: Mesh) -> dict[str, int]:
+    """{axis name: size} in mesh order — the shape dict shardcheck's
+    replica-group attribution and the budget files key on."""
+    return {name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def replicated_abstract(mesh: Mesh, tree):
+    """Abstract twin of a pytree with every leaf REPLICATED over the
+    mesh — the lowering helper for AOT-analyzing today's single-chip
+    serve programs under a declared mesh (shardcheck): lowering with
+    these shardings makes the SPMD partitioner run for real, so any
+    collective it inserts is by definition accidental."""
+    import jax
+
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+        tree)
 
 
 def make_mesh(mesh_dp: int = -1, mesh_fsdp: int = 1, mesh_tp: int = 1,

@@ -45,6 +45,18 @@ def _tp_dim(path: str, ndim: int) -> int | None:
 
 def spec_for_param(path: str, shape: tuple[int, ...], *, axis_sizes: dict,
                    shard_params: bool, tp: bool) -> P:
+    from nanosandbox_tpu.parallel.mesh import REGISTERED_AXES
+
+    unknown = set(axis_sizes) - REGISTERED_AXES
+    if unknown:
+        # The rule table below only places registered axes, but the
+        # mesh handed in must speak the same axis vocabulary or the
+        # P() fallbacks would silently replicate what the caller
+        # thought was sharded (jaxlint's axis-mismatch rule is the
+        # static twin of this check).
+        raise ValueError(
+            f"mesh axis names {sorted(unknown)} are not in the "
+            f"registered set {sorted(REGISTERED_AXES)}")
     ndim = len(shape)
     placement: list[Any] = [None] * ndim
 

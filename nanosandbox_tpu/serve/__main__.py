@@ -43,6 +43,14 @@ def main(argv: list[str] | None = None) -> None:
                          "loop)")
     ap.add_argument("--spec_k", type=int, default=4,
                     help="draft tokens per verify step (--spec only)")
+    ap.add_argument("--shardcheck_budget", default=None,
+                    help="shardcheck comms budget to export as "
+                         "shardcheck_collectives_total{program=,kind=} "
+                         "gauges on /metrics at startup (the pinned "
+                         "comms contract this engine runs under); "
+                         "default budgets/serve_cpu8.json, skipped "
+                         "silently when absent — an EXPLICIT path must "
+                         "exist; '' disables")
     ap.add_argument("--warmup", choices=("full", "buckets"), default="full",
                     help="'full' compiles every (wave-size, bucket) "
                          "prefill pair before binding the port (the "
@@ -57,6 +65,33 @@ def main(argv: list[str] | None = None) -> None:
     from nanosandbox_tpu.serve.engine import Engine
     from nanosandbox_tpu.serve.http import EngineLoop, make_server
     from nanosandbox_tpu.train import restore_for_inference
+
+    # Load the shardcheck budget BEFORE the restore + warmup compiles:
+    # a typo'd path or corrupt file must fail in milliseconds, not
+    # after minutes of prefill-grid compilation. (The export itself
+    # happens post-warmup, next to the other /metrics publishing.)
+    # None (flag not given) falls back to the committed default and is
+    # skipped when absent; an EXPLICIT path — even one spelling out the
+    # default — must exist (argparse cannot tell a typed-out default
+    # from the fallback, so the sentinel is None, not the path).
+    shardcheck_budget = None
+    implicit_budget = args.shardcheck_budget is None
+    budget_path = ("budgets/serve_cpu8.json" if implicit_budget
+                   else args.shardcheck_budget)
+    if budget_path:
+        import os
+
+        if os.path.exists(budget_path):
+            from nanosandbox_tpu.analysis.shardcheck import load_budget
+
+            try:
+                shardcheck_budget = load_budget(budget_path)
+            except ValueError as e:
+                raise SystemExit(f"--shardcheck_budget: {e}")
+        elif not implicit_budget:
+            raise SystemExit(
+                f"--shardcheck_budget={budget_path}: no such file (only "
+                "the implicit default is skipped when absent)")
 
     trainer, state, step = restore_for_inference(
         args.out_dir, data_dir=args.data_dir, device=args.device)
@@ -119,6 +154,19 @@ def main(argv: list[str] | None = None) -> None:
           + f" (pipeline={'on' if engine.pipeline else 'off'})",
           file=sys.stderr, flush=True)
     engine.reset_latency_stats()  # /stats should describe live traffic
+    # Publish the pinned comms contract (shardcheck budget) as gauges on
+    # the process-global registry so every /metrics scrape carries the
+    # collective counts this deployment is budgeted for — a TP-serving
+    # rollout that rewrites the budget becomes visible in the same
+    # dashboard that watches its latency.
+    if shardcheck_budget is not None:
+        from nanosandbox_tpu.analysis.shardcheck import (
+            export_manifest_metrics)
+        from nanosandbox_tpu.obs import global_registry
+
+        export_manifest_metrics(shardcheck_budget, global_registry())
+        print(f"[serve] shardcheck budget {budget_path} exported to "
+              "/metrics", file=sys.stderr, flush=True)
     loop = EngineLoop(engine)
     loop.start()
     server = make_server(args.host, args.port, loop, tok.encode,

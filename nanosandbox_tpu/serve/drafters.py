@@ -170,6 +170,52 @@ class ModelDrafter:
                                          active)
         return drafts
 
+    def shardcheck_programs(self, mesh, *, buckets=(), rungs=()) -> list:
+        """ProgramSpecs for the drafter's compiled set (draft scan +
+        the draft_prefill grid) under the engine's replicated-on-mesh
+        contract — see Engine.shardcheck_programs. Requires build()."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from nanosandbox_tpu.analysis.shardcheck import (Expectations,
+                                                         ProgramSpec)
+        from nanosandbox_tpu.parallel.mesh import replicated_abstract
+
+        if self._pool is None:
+            raise RuntimeError("shardcheck_programs requires build() — "
+                               "construct the Engine with this drafter "
+                               "first")
+        rep = NamedSharding(mesh, PartitionSpec())
+        aparams = replicated_abstract(mesh, self.params)
+        apool = replicated_abstract(mesh, self._pool)
+        expect = Expectations(comms_free=True)
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
+        def jit_rep(fn):
+            return jax.jit(fn, in_shardings=rep, out_shardings=rep)
+
+        S = self.num_slots
+        args = (aparams, apool, sds((S,), jnp.int32), sds((S,), jnp.int32),
+                sds((S,), jnp.bool_))
+        specs = [ProgramSpec(
+            name="drafter_draft",
+            lower=lambda: jit_rep(self._draft_fn).lower(*args),
+            abstract_args=args, expect=expect, tags=("serve", "drafter"))]
+        for bucket in buckets:
+            for k in rungs:
+                pargs = (aparams, apool, sds((k, bucket), jnp.int32),
+                         sds((k,), jnp.int32))
+                specs.append(ProgramSpec(
+                    name=f"drafter_prefill_k{k}_L{bucket}",
+                    lower=(lambda pargs=pargs:
+                           jit_rep(self._prefill_fn).lower(*pargs)),
+                    abstract_args=pargs, expect=expect,
+                    tags=("serve", "drafter")))
+        return specs
+
     # -- compiled bodies ---------------------------------------------------
 
     def _prefill_fn(self, dparams, dpool, prompts, slots):

@@ -731,6 +731,59 @@ class Engine:
             progs.update(self._spec.programs)
         return progs
 
+    def shardcheck_programs(self, mesh) -> list:
+        """ProgramSpecs for the comms analyzer (analysis/shardcheck):
+        the engine's full compiled set — decode, the prefill
+        ladder x bucket grid, and (with spec=...) the verify/drafter
+        programs — AOT-lowered under ``mesh`` with every operand
+        REPLICATED. That is today's single-chip contract stated on the
+        mesh: the partitioner runs for real, so the committed budgets
+        pin ZERO collectives, and ROADMAP item 1's tensor-parallel
+        serving must rewrite them explicitly. Fresh jits: an analysis
+        lower must not consume the live tracecheck budgets."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from nanosandbox_tpu.analysis.shardcheck import (Expectations,
+                                                         ProgramSpec)
+        from nanosandbox_tpu.parallel.mesh import replicated_abstract
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        aparams = replicated_abstract(mesh, self.params)
+        apool = replicated_abstract(mesh, self._pool)
+        astate = replicated_abstract(mesh, self._state)
+        expect = Expectations(comms_free=True)
+
+        def jit_rep(fn):
+            return jax.jit(fn, in_shardings=rep, out_shardings=rep)
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
+        specs = [ProgramSpec(
+            name="decode",
+            lower=lambda: jit_rep(self._decode_fn).lower(aparams, apool,
+                                                         astate),
+            abstract_args=(aparams, apool, astate),
+            expect=expect, tags=("serve",))]
+        for bucket in self.sched.buckets:
+            for k in self.admit_buckets:
+                args = (aparams, apool, sds((k, bucket), jnp.int32),
+                        sds((k,), jnp.int32), sds((k,), jnp.int32),
+                        sds((k,), jnp.float32), sds((k,), jnp.int32),
+                        sds((k,), jnp.float32), sds((k,), jnp.int32))
+                specs.append(ProgramSpec(
+                    name=f"prefill_k{k}_L{bucket}",
+                    lower=(lambda args=args:
+                           jit_rep(self._prefill_fn).lower(*args)),
+                    abstract_args=args, expect=expect, tags=("serve",)))
+        if self._spec is not None:
+            specs.extend(self._spec.shardcheck_programs(
+                mesh, aparams=aparams, apool=apool, astate=astate,
+                buckets=self.sched.buckets, rungs=self.admit_buckets))
+        return specs
+
     @property
     def trace_counts(self) -> Dict[str, int]:
         """Observed traces per program kind, read from the tracecheck

@@ -232,6 +232,36 @@ class SpecRunner:
         return pool, new_state, emitted, counts, a * live
 
     # ------------------------------------------------------------------
+    def shardcheck_programs(self, mesh, *, aparams, apool, astate,
+                            buckets=(), rungs=()) -> list:
+        """ProgramSpecs for the verify program (and, for a device
+        drafter, its draft/draft_prefill programs) — the speculative
+        half of Engine.shardcheck_programs, same replicated-on-the-mesh
+        contract and the same comms-free expectation."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from nanosandbox_tpu.analysis.shardcheck import (Expectations,
+                                                         ProgramSpec)
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        drafts = jax.ShapeDtypeStruct((self.num_slots, self.k), jnp.int32,
+                                      sharding=rep)
+        dlen = jax.ShapeDtypeStruct((self.num_slots,), jnp.int32,
+                                    sharding=rep)
+        args = (aparams, apool, astate, drafts, dlen)
+        specs = [ProgramSpec(
+            name="spec_verify",
+            lower=lambda: jax.jit(self._verify_fn, in_shardings=rep,
+                                  out_shardings=rep).lower(*args),
+            abstract_args=args,
+            expect=Expectations(comms_free=True), tags=("serve", "spec"))]
+        if self.drafter.kind == "device":
+            specs.extend(self.drafter.shardcheck_programs(
+                mesh, buckets=buckets, rungs=rungs))
+        return specs
+
     def stats(self) -> dict:
         rate: Optional[float] = (self.accepted / self.drafted
                                  if self.drafted else None)

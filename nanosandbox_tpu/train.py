@@ -493,6 +493,7 @@ class Trainer:
                                   self.batch_sharding, None),
                     out_shardings=(self.state_shardings, None),
                     donate_argnums=(0,) if on_accel else ())
+                # jaxlint: disable=unconstrained-output -- scalar loss output: nothing mesh-sized to constrain
                 self._eval_step = jax.jit(
                     eval_fn,
                     in_shardings=(self.state_shardings, self.batch_sharding,
@@ -544,6 +545,58 @@ class Trainer:
                             + ma.generated_code_size_in_bytes
                             - ma.alias_size_in_bytes),
         }
+
+    # -- sharding analysis (shardcheck program enumeration) ------------------
+
+    def shardcheck_programs(self) -> list:
+        """ProgramSpecs for the comms analyzer (analysis/shardcheck):
+        the train and eval steps AOT-lowered under this trainer's mesh
+        with the REAL in/out shardings. Fresh ``jax.jit`` objects, not
+        the guarded ``compiled_steps`` ones — an analysis lower must not
+        consume the tracecheck retrace budgets the live loop enforces.
+
+        Expectations encode the mesh contract: full param gathers are
+        the point of ZeRO-3 (fsdp) and ring attention's transposes
+        (seq), TP activations gather over model — but the data axis
+        carries gradient all-reduces ONLY, and nothing may materialize
+        a sharded tensor on any other axis."""
+        import jax
+        import jax.numpy as jnp
+
+        from nanosandbox_tpu.analysis.shardcheck import (Expectations,
+                                                         ProgramSpec)
+
+        rows = self.cfg.sequences_per_iter
+        batch = jax.ShapeDtypeStruct((rows, self.cfg.block_size), jnp.int32,
+                                     sharding=self.batch_sharding)
+        key = self.train_rng(0)
+        expect = Expectations(gather_ok_axes=("fsdp", "seq", "model"),
+                              allreduce_only_axes=("data",))
+
+        def lower_train():
+            return jax.jit(
+                self._train_step_fn,
+                in_shardings=(self.state_shardings, self.batch_sharding,
+                              self.batch_sharding, None),
+                out_shardings=(self.state_shardings, None),
+            ).lower(self.abstract_state, batch, batch, key)
+
+        def lower_eval():
+            # jaxlint: disable=unconstrained-output -- scalar loss output: nothing mesh-sized to constrain
+            return jax.jit(
+                self._eval_step_fn,
+                in_shardings=(self.state_shardings, self.batch_sharding,
+                              self.batch_sharding),
+            ).lower(self.abstract_state, batch, batch)
+
+        return [
+            ProgramSpec(name="train_step", lower=lower_train,
+                        abstract_args=(self.abstract_state, batch, batch),
+                        expect=expect, tags=("train",)),
+            ProgramSpec(name="eval_step", lower=lower_eval,
+                        abstract_args=(self.abstract_state, batch, batch),
+                        expect=expect, tags=("train",)),
+        ]
 
     # -- data ----------------------------------------------------------------
 

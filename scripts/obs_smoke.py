@@ -80,6 +80,17 @@ def main() -> int:
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     engine = Engine(model, params, num_slots=4, max_len=64)
+    # The startup step `python -m nanosandbox_tpu.serve` performs: the
+    # pinned shardcheck comms budget rides /metrics as
+    # shardcheck_collectives_total{program=,kind=} gauges.
+    from nanosandbox_tpu.analysis.shardcheck import (export_manifest_metrics,
+                                                     load_budget)
+    from nanosandbox_tpu.obs import global_registry
+
+    export_manifest_metrics(
+        load_budget(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "budgets", "serve_cpu8.json")),
+        global_registry())
     loop = EngineLoop(engine)
     loop.start()
     encode = lambda s: [min(ord(c), cfg.vocab_size - 1) for c in s]  # noqa: E731
@@ -113,6 +124,11 @@ def main() -> int:
             assert required in types, (required, sorted(types))
         assert types["serve_ttft_seconds"] == "histogram"
         assert "serve_ttft_seconds_window" in types  # percentile summary
+        # The pinned comms contract is on the scrape: every serve
+        # program's collective count (zero today — single-chip).
+        assert "shardcheck_collectives_total" in types, sorted(types)
+        assert 'shardcheck_collectives_total{program="decode",' \
+            in text, "decode gauge missing from exposition"
 
         trace = json.loads(get(f"/trace?rid={rid}"))
         validate_chrome_trace(trace)

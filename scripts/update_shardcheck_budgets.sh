@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Regenerate the committed shardcheck comms budgets (budgets/*.json).
+#
+# This is the EXPLICIT ratchet step: budgets only change when a human
+# runs this and commits the diff — which is the whole point. A PR that
+# legitimately adds communication (e.g. ROADMAP item 1's tensor-parallel
+# serving) regenerates here and the budget diff becomes part of its
+# review; a PR that fails the CI shardcheck gate without having meant to
+# touch comms has found a real accidental collective instead.
+#
+# Budgets are per-mesh, per-runtime contracts: the provenance block
+# records the jax/jaxlib that produced them, and the checker notes a
+# drift (regenerate after a pinned-version bump if the check fails).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m nanosandbox_tpu.analysis shardcheck --fleet=train \
+    --write-budget=budgets/train_cpu8.json
+python -m nanosandbox_tpu.analysis shardcheck --fleet=serve \
+    --write-budget=budgets/serve_cpu8.json
+
+echo "regenerated budgets/train_cpu8.json + budgets/serve_cpu8.json —"
+echo "review the diff and commit it WITH the change that moved the needle"

@@ -190,6 +190,73 @@ class Engine:
         self._step = jax.jit(budget("step", 1)(self._step_fn))
 """,
     ),
+    "unconstrained-output": (
+        # in_shardings declared, output layout left to the partitioner.
+        """
+import jax
+
+def step_fn(state, batch):
+    return state
+
+def build(state_shardings):
+    return jax.jit(step_fn, in_shardings=(state_shardings, None))
+""",
+        # Pinned output layout (out_shardings); a second root constrains
+        # its intermediate instead — both spellings are clean.
+        """
+import jax
+from jax.lax import with_sharding_constraint
+
+def step_fn(state, batch):
+    return state
+
+def frontier_fn(pool, start, sharding):
+    pool = with_sharding_constraint(pool, sharding)
+    return pool
+
+def build(state_shardings, rep):
+    a = jax.jit(step_fn, in_shardings=(state_shardings, None),
+                out_shardings=state_shardings)
+    b = jax.jit(frontier_fn, in_shardings=(state_shardings, None, None))
+    return a, b
+""",
+    ),
+    "implicit-replication": (
+        # Placement-less device_put in a module that builds meshes.
+        """
+import jax
+from jax.sharding import NamedSharding
+
+def place(params):
+    return jax.device_put(params)
+""",
+        # Spelled-out placement (positional or keyword).
+        """
+import jax
+from jax.sharding import NamedSharding
+
+def place(params, sharding):
+    a = jax.device_put(params, sharding)
+    b = jax.device_put(params, device=sharding)
+    return a, b
+""",
+    ),
+    "axis-mismatch": (
+        # 'sequence' is not a registered mesh axis (it's 'seq').
+        """
+from jax.sharding import PartitionSpec as P
+
+BATCH = P(("data", "fsdp"), "sequence")
+""",
+        # Registered names only — including inside tuple groups.
+        """
+from jax.sharding import PartitionSpec as P
+
+BATCH = P(("data", "fsdp"), "seq")
+PARAM = P(None, "model")
+REPL = P()
+""",
+    ),
 }
 
 
@@ -307,6 +374,75 @@ def test_suppression_for_other_rule_does_not_apply():
     assert "nonstatic-shape" in rules
 
 
+def test_unused_reasoned_suppression_reported_and_strict():
+    """ISSUE 7 satellite: a reasoned disable whose line no longer trips
+    its rule is reported (and --strict-suppressions makes it a
+    finding), so audits can't rot in place."""
+    from nanosandbox_tpu.analysis.core import drain_unused_suppressions
+
+    drain_unused_suppressions()
+    src = "x = 1  # jaxlint: disable=host-sync -- stale audit\n"
+    findings, suppressed = analyze_source(src, "mod.py")
+    assert findings == [] and suppressed == 0
+    unused = drain_unused_suppressions()
+    assert len(unused) == 1
+    assert unused[0]["rules"] == ["host-sync"]
+    assert unused[0]["reason"] == "stale audit"
+
+    # strict: the rot becomes a finding (and the CI gate trips).
+    findings, _ = analyze_source(src, "mod.py", strict_suppressions=True)
+    assert [f.rule for f in findings] == ["unused-suppression"]
+    drain_unused_suppressions()
+
+    # A USED suppression is never reported unused.
+    used = FIXTURES["nonstatic-shape"][0].replace(
+        "return prefill(prompts)",
+        "return prefill(prompts)"
+        "  # jaxlint: disable=nonstatic-shape -- test rig, one shape")
+    findings, suppressed = analyze_source(used, "mod.py",
+                                          strict_suppressions=True)
+    assert findings == [] and suppressed == 1
+    assert drain_unused_suppressions() == []
+
+
+def test_unused_suppression_not_judged_under_select():
+    """--select runs a rule subset; a suppression for an unselected
+    rule never got a chance to match and must not be called unused."""
+    from nanosandbox_tpu.analysis.core import drain_unused_suppressions
+
+    drain_unused_suppressions()
+    src = "x = 1  # jaxlint: disable=host-sync -- audited elsewhere\n"
+    findings, _ = analyze_source(src, "mod.py", select=["tracer-leak"],
+                                 strict_suppressions=True)
+    assert findings == []
+    assert drain_unused_suppressions() == []
+    # `disable=all` may suppress ANY rule, so it is only judged under a
+    # full run — an unselected rule could be what it audits.
+    src = "y = 2  # jaxlint: disable=all -- audited readback\n"
+    findings, _ = analyze_source(src, "mod.py", select=["tracer-leak"],
+                                 strict_suppressions=True)
+    assert findings == []
+    assert drain_unused_suppressions() == []
+    findings, _ = analyze_source(src, "mod.py", strict_suppressions=True)
+    assert [f.rule for f in findings] == ["unused-suppression"]
+    drain_unused_suppressions()
+
+
+def test_report_carries_unused_suppressions(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("y = 2  # jaxlint: disable=tracer-leak -- old fix\n")
+    report = analyze_paths([str(tmp_path)])
+    assert report["summary"]["findings"] == 0
+    assert len(report["unused_suppressions"]) == 1
+    assert report["unused_suppressions"][0]["line"] == 1
+    from nanosandbox_tpu.analysis import render_text
+
+    assert "unused suppression" in render_text(report)
+    # strict run: same tree now fails.
+    report = analyze_paths([str(tmp_path)], strict_suppressions=True)
+    assert report["summary"]["by_rule"] == {"unused-suppression": 1}
+
+
 # ------------------------------------------------------------ report + CLI
 
 def test_parse_error_is_a_finding_not_a_crash():
@@ -346,6 +482,55 @@ def test_cli_exit_codes_and_artifact(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
 
 
+def test_changed_only_resolves_from_git_diff(tmp_path):
+    """ISSUE 7 satellite: --changed-only lints the `git diff
+    --name-only <base>` set — the fast pre-commit run."""
+    from nanosandbox_tpu.analysis.__main__ import changed_only_paths
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path), "PATH": "/usr/bin:/bin"})
+
+    git("init", "-q")
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "a.py").write_text("x = 1\n")
+    (tmp_path / "pkg" / "b.py").write_text("y = 1\n")
+    (tmp_path / "other.py").write_text("z = 1\n")
+    (tmp_path / "notes.txt").write_text("n\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+
+    # Nothing changed -> empty set.
+    assert changed_only_paths(["pkg"], "HEAD", cwd=tmp_path) == []
+
+    (tmp_path / "pkg" / "a.py").write_text(FIXTURES["tracer-leak"][0])
+    (tmp_path / "other.py").write_text("z = 2\n")   # outside pkg/
+    (tmp_path / "notes.txt").write_text("m\n")      # not .py
+    changed = changed_only_paths(["pkg"], "HEAD", cwd=tmp_path)
+    assert [Path(p).name for p in changed] == ["a.py"]
+    # The resolved set feeds the ordinary analyzer and finds the leak.
+    report = analyze_paths(changed)
+    assert report["summary"]["by_rule"] == {"tracer-leak": 3}
+
+    # Invoked from a subdirectory, git paths still resolve against the
+    # repo ROOT (git prints root-relative names) — and a lint root that
+    # does not exist from the invocation dir fails loudly instead of
+    # silently matching nothing.
+    sub = tmp_path / "pkg"
+    changed = changed_only_paths(["."], "HEAD", cwd=sub)
+    assert [Path(p).name for p in changed] == ["a.py"]
+    with pytest.raises(RuntimeError, match="do not exist"):
+        changed_only_paths(["pkg"], "HEAD", cwd=sub)
+
+    # A bad base ref is a usage error, not a crash.
+    with pytest.raises(RuntimeError, match="git diff"):
+        changed_only_paths(["pkg"], "no-such-ref", cwd=tmp_path)
+
+
 def test_cli_runs_without_jax_importable():
     """The CI lint job runs jaxlint on a bare Python: make the 'no jax
     needed' contract executable by poisoning jax at import time."""
@@ -375,3 +560,7 @@ def test_package_tree_is_clean():
     # The deliberate syncs (engine readbacks, benchmarking fences...)
     # are suppressed WITH reasons, not invisible.
     assert report["summary"]["suppressed"] >= 5
+    # And none of those audits has rotted: every reasoned disable in
+    # the tree still matches a live finding (the CI gate runs
+    # --strict-suppressions, so rot would fail there too).
+    assert report["unused_suppressions"] == []

@@ -63,6 +63,79 @@ def test_spec_rules():
     assert s == P()
 
 
+def test_spec_rejects_unregistered_mesh_axes():
+    # The runtime twin of jaxlint's axis-mismatch rule: a mesh speaking
+    # a different axis vocabulary must fail loudly, not silently
+    # replicate what the caller thought was sharded.
+    with pytest.raises(ValueError, match="registered"):
+        spec_for_param("h_0/attn/c_attn/kernel", (64, 192),
+                       axis_sizes={"data": 2, "sequence": 4},
+                       shard_params=True, tp=True)
+
+
+@pytest.mark.parametrize("mesh_args,shard_params,tp", [
+    ((8, 1, 1, 1), False, False),   # dp: everything replicated
+    ((1, 8, 1, 1), True, False),    # fsdp: ZeRO-3 sharding
+    ((1, 1, 1, 8), False, False),   # sp: params replicated over seq
+    ((1, 1, 8, 1), False, True),    # tp: Megatron kernel placement
+])
+def test_param_shardings_rule_table(mesh_args, shard_params, tp):
+    """ISSUE 7 satellite: every param pytree leaf gets an explicit spec
+    under each of the dp/fsdp/sp/tp meshes, and specs only name
+    registered mesh axes — the invariant jaxlint's axis-mismatch rule
+    enforces statically (pinned against parallel.mesh.AXES in
+    test_shardcheck.py)."""
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.parallel.mesh import REGISTERED_AXES
+    from nanosandbox_tpu.parallel.sharding import param_shardings
+
+    mesh = make_mesh(*mesh_args)
+    cfg = GPTConfig(n_layer=2, n_head=4, n_embd=64, block_size=64,
+                    vocab_size=256, dropout=0.0)
+    model = GPT(cfg)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32)))["params"]
+    shardings = param_shardings(mesh, abstract,
+                                shard_params=shard_params, tp=tp)
+    leaves = jax.tree_util.tree_leaves_with_path(shardings)
+    assert len(leaves) == len(jax.tree.leaves(abstract)) > 10
+
+    def axes_of(spec):
+        return {a for entry in spec if entry
+                for a in ((entry,) if isinstance(entry, str) else entry)}
+
+    for path, sharding in leaves:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        assert isinstance(sharding, jax.sharding.NamedSharding), name
+        used = axes_of(sharding.spec)
+        # Only registered axis names, and only axes of THIS mesh with
+        # size > 1 (a spec naming a trivial axis is a latent surprise).
+        assert used <= REGISTERED_AXES, (name, sharding.spec)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        assert all(sizes[a] > 1 for a in used), (name, sharding.spec)
+
+    flat = {"/".join(str(getattr(p, "key", p)) for p in path): s
+            for path, s in leaves}
+    if shard_params:       # fsdp=8: divisible kernels actually shard
+        assert any("fsdp" in axes_of(s.spec) for s in flat.values())
+        # embeddings shard their ROW dim only (vocab 256 % 8 == 0)
+        wte = next(s for n, s in flat.items() if n.endswith("wte/embedding"))
+        assert wte.spec == P("fsdp", None)
+    elif tp:               # model=8: Megatron column/row placement
+        cattn = next(s for n, s in flat.items()
+                     if n.endswith("c_attn/kernel"))
+        cproj = next(s for n, s in flat.items()
+                     if "attn" in n and n.endswith("c_proj/kernel"))
+        assert cattn.spec == P(None, "model")
+        assert cproj.spec == P("model", None)
+        wte = next(s for n, s in flat.items() if n.endswith("wte/embedding"))
+        assert wte.spec == P()      # weight-tied head stays replicated
+    else:                  # dp / sp: pure replication
+        assert all(s.spec == P() for s in flat.values())
+
+
 @pytest.mark.parametrize("mesh_kw", [
     dict(),                                   # pure DP over 8
     dict(mesh_dp=2, mesh_fsdp=4, shard_params=True),   # DP x FSDP

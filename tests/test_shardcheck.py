@@ -1,0 +1,473 @@
+"""shardcheck tests: grammar, axis attribution, rules, budgets, fleets.
+
+The ISSUE 7 acceptance bar:
+  * a manifest for >= 6 distinct compiled programs (train step, eval,
+    decode, >= 2 prefill rungs, spec verify) on the 8-device CPU mesh;
+  * the deliberately-injected unconstrained output (the frontier_slice
+    fixture dropping its with_sharding_constraint) is caught as an
+    accidental-all-gather finding with nonzero byte attribution;
+  * the committed budgets pass clean at zero findings.
+
+Layered like the tool: the HLO grammar and budget checker are pure
+stdlib (no compile in the loop), the rule layer is fed synthetic
+manifests, and ONE module-scoped fleet fixture pays the compile cost
+for every integration assertion.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from nanosandbox_tpu.analysis.shardcheck.budget import (budget_from_manifest,
+                                                        check_budget)
+from nanosandbox_tpu.analysis.shardcheck.hlo import (parse_hlo_collectives,
+                                                     parse_replica_groups)
+from nanosandbox_tpu.analysis.shardcheck.manifest import (Expectations,
+                                                          agg_key,
+                                                          attribute_axes,
+                                                          axis_groups)
+from nanosandbox_tpu.analysis.shardcheck.rules import check_program
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------- HLO grammar
+
+HLO_SAMPLE = """\
+HloModule jit_f, entry_computation_layout={...}
+
+%region_0.6 (a: f32[], b: f32[]) -> f32[] {
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main.10 {
+  %param.1 = f32[128,32]{1,0} parameter(0)
+  %param.2 = f32[2,256]{1,0} parameter(1), sharding={replicated}
+  %all-gather = f32[256,32]{1,0} all-gather(f32[128,32]{1,0} %param.1), \
+channel_id=1, replica_groups={{0,2},{4,6},{1,3},{5,7}}, dimensions={0}, \
+use_global_device_ids=true, metadata={op_name="jit(f)/dot_general"}
+  %all-reduce = f32[] all-reduce(f32[] %fusion), channel_id=2, \
+replica_groups=[4,2]<=[8], use_global_device_ids=true, to_apply=%region_0.6
+  ROOT %all-reduce.1 = f32[] all-reduce(f32[] %all-reduce), channel_id=3, \
+replica_groups=[2,4]<=[4,2]T(1,0), use_global_device_ids=true, \
+to_apply=%region_0.6
+  %cp = f32[8,16]{1,0} collective-permute(f32[8,16]{1,0} %x), channel_id=4, \
+source_target_pairs={{0,2},{2,0},{1,3},{3,1}}
+  %aa = (f32[64,8]{1,0}, f32[64,8]{1,0}) all-to-all(f32[64,8]{1,0} %y, \
+f32[64,8]{1,0} %z), channel_id=5, replica_groups={{0,1},{2,3}}
+}
+"""
+
+
+def test_hlo_parser_extracts_collectives():
+    parsed = parse_hlo_collectives(HLO_SAMPLE)
+    by_kind = {c.kind: c for c in parsed.collectives}
+    assert set(by_kind) == {"all-gather", "all-reduce",
+                            "collective-permute", "all-to-all"}
+    assert len(parsed.collectives) == 5  # two all-reduces
+
+    ag = by_kind["all-gather"]
+    assert ag.bytes_in == 128 * 32 * 4
+    assert ag.bytes_out == 256 * 32 * 4
+    assert ag.bytes_moved == ag.bytes_out       # gathers charge the result
+    assert ag.groups == frozenset({frozenset({0, 2}), frozenset({4, 6}),
+                                   frozenset({1, 3}), frozenset({5, 7})})
+    assert ag.operand_params == (0,)            # fed by parameter(0)
+
+    cp = by_kind["collective-permute"]
+    assert cp.pairs == ((0, 2), (2, 0), (1, 3), (3, 1))
+    assert cp.bytes_moved == 8 * 16 * 4
+
+    aa = by_kind["all-to-all"]
+    assert aa.bytes_out == 2 * 64 * 8 * 4       # tuple result summed
+    assert parsed.params == {"param.1": 0, "param.2": 1}
+
+
+def test_iota_replica_groups():
+    # [4,2]<=[8]: iota(8) -> rows of 2.
+    assert parse_replica_groups("replica_groups=[4,2]<=[8]") == frozenset(
+        frozenset(p) for p in [(0, 1), (2, 3), (4, 5), (6, 7)])
+    # [2,4]<=[4,2]T(1,0): transpose interleaves -> stride-2 groups.
+    assert parse_replica_groups(
+        "replica_groups=[2,4]<=[4,2]T(1,0)") == frozenset(
+        frozenset(p) for p in [(0, 2, 4, 6), (1, 3, 5, 7)])
+
+
+def test_parser_skips_async_done_and_metadata_strings():
+    text = """
+  %ags = f32[8]{0} all-gather-start(f32[4]{0} %p), replica_groups={{0,1}}
+  %agd = f32[8]{0} all-gather-done(f32[8]{0} %ags)
+  %fusion = f32[] fusion(f32[] %q), metadata={op_name="fake all-reduce(x)"}
+"""
+    parsed = parse_hlo_collectives(text)
+    assert len(parsed.collectives) == 1
+    assert parsed.collectives[0].kind == "all-gather"
+
+
+def test_async_start_tuple_result_counts_output_only():
+    """The TPU async form returns (operand, output[, contexts]); the
+    operand echo must not double-charge bytes_out or break the
+    full-input-gather byte match."""
+    text = """
+  %ags = (f32[128,32]{1,0}, f32[256,32]{1,0}) all-gather-start(\
+f32[128,32]{1,0} %p), channel_id=1, replica_groups={{0,1}}, dimensions={0}
+  %cps = (f32[8,16]{1,0}, f32[8,16]{1,0}, u32[], u32[]) \
+collective-permute-start(f32[8,16]{1,0} %x), source_target_pairs={{0,1}}
+"""
+    parsed = parse_hlo_collectives(text)
+    ag, cp = parsed.collectives
+    assert ag.kind == "all-gather"
+    assert ag.bytes_in == 128 * 32 * 4
+    assert ag.bytes_out == 256 * 32 * 4      # NOT operand + output
+    assert ag.bytes_moved == 256 * 32 * 4
+    assert cp.kind == "collective-permute"
+    assert cp.bytes_out == 8 * 16 * 4        # context scalars excluded
+
+
+# --------------------------------------------------------- axis attribution
+
+MESH_SIZES = {"data": 1, "fsdp": 2, "seq": 2, "model": 2}
+
+
+def _groups(*sets):
+    return frozenset(frozenset(s) for s in sets)
+
+
+def test_axis_groups_cover_and_attribution():
+    gi = axis_groups(MESH_SIZES)
+    # model is the innermost axis (stride 1), fsdp outermost live axis
+    # (stride 4) — matching make_mesh's (data, fsdp, seq, model) layout.
+    import dataclasses
+
+    from nanosandbox_tpu.analysis.shardcheck.hlo import Collective
+
+    def coll(groups=None, pairs=()):
+        return Collective(kind="x", name="x", bytes_in=0, bytes_out=0,
+                          groups=groups, pairs=pairs)
+
+    assert attribute_axes(
+        coll(groups=_groups((0, 1), (2, 3), (4, 5), (6, 7))),
+        MESH_SIZES, gi) == ("model",)
+    assert attribute_axes(
+        coll(groups=_groups((0, 2), (1, 3), (4, 6), (5, 7))),
+        MESH_SIZES, gi) == ("seq",)
+    assert attribute_axes(
+        coll(groups=_groups((0, 4), (1, 5), (2, 6), (3, 7))),
+        MESH_SIZES, gi) == ("fsdp",)
+    assert attribute_axes(
+        coll(groups=_groups((0, 2, 4, 6), (1, 3, 5, 7))),
+        MESH_SIZES, gi) == ("fsdp", "seq")
+    assert attribute_axes(
+        coll(groups=_groups(tuple(range(8)))),
+        MESH_SIZES, gi) == ("fsdp", "seq", "model")
+    # permute pairs stepping one axis
+    assert attribute_axes(coll(pairs=((0, 2), (2, 0), (1, 3), (3, 1))),
+                          MESH_SIZES, gi) == ("seq",)
+    # a group structure matching no axis subset
+    assert attribute_axes(coll(groups=_groups((0, 3), (1, 2), (4, 7),
+                                              (5, 6))),
+                          MESH_SIZES, gi) == ("unknown",)
+    # size-1 groups move nothing
+    assert attribute_axes(coll(groups=_groups((0,), (1,))),
+                          MESH_SIZES, gi) == ()
+    assert dataclasses.is_dataclass(coll())
+
+
+def test_registered_axes_match_static_rule_mirror():
+    # The jaxlint axis-mismatch rule mirrors parallel.mesh.AXES without
+    # importing jax; this is the pin that keeps the mirror honest.
+    from nanosandbox_tpu.analysis.rules_sharding import REGISTERED_AXIS_NAMES
+    from nanosandbox_tpu.parallel.mesh import AXES, REGISTERED_AXES
+
+    assert tuple(REGISTERED_AXIS_NAMES) == tuple(AXES)
+    assert REGISTERED_AXES == frozenset(AXES)
+
+
+# ----------------------------------------------------------- manifest rules
+
+
+def _entry(collectives=None, full_gathers=(), donated=()):
+    colls = {}
+    for kind, axes, count, bytes_ in (collectives or []):
+        colls[agg_key(kind, axes)] = {
+            "kind": kind, "axes": list(axes), "count": count,
+            "bytes_moved": bytes_, "max_bytes_out": bytes_}
+    return {"collectives": colls,
+            "full_input_gathers": list(full_gathers),
+            "donated_param_comms": list(donated)}
+
+
+def test_rule_comms_free_violation():
+    entry = _entry([("all-gather", ("fsdp",), 2, 1024)])
+    found = check_program("decode", entry, Expectations(comms_free=True))
+    assert [f["rule"] for f in found] == ["comms-free-violation"]
+    assert found[0]["bytes"] == 1024
+    assert not check_program("decode", _entry(),
+                             Expectations(comms_free=True))
+
+
+def test_rule_accidental_all_gather_gated_by_expected_axes():
+    fg = {"axes": ["fsdp"], "bytes": 65536, "materializes": "arg0/w",
+          "instr": "all-gather.1"}
+    entry = _entry([("all-gather", ("fsdp",), 1, 65536)], full_gathers=[fg])
+    # ZeRO-3 declares fsdp gathers expected -> clean.
+    assert not check_program("train_step", entry,
+                             Expectations(gather_ok_axes=("fsdp",)))
+    # Undeclared -> accidental, bytes attributed.
+    found = check_program("train_step", entry, Expectations())
+    assert [f["rule"] for f in found] == ["accidental-all-gather"]
+    assert found[0]["bytes"] == 65536
+    assert "arg0/w" in found[0]["message"]
+
+
+def test_rule_dp_axis_and_fusion_bound():
+    entry = _entry([("all-gather", ("data",), 1, 512),
+                    ("all-reduce", ("data",), 9, 4096)])
+    found = check_program(
+        "train_step", entry,
+        Expectations(allreduce_only_axes=("data",), max_axis_allreduces=4))
+    rules = sorted(f["rule"] for f in found)
+    assert rules == ["unexpected-dp-collective", "unfused-grad-allreduce"]
+    # Within the bound, all-reduce on dp is the expected gradient sync.
+    entry = _entry([("all-reduce", ("data",), 3, 4096)])
+    assert not check_program(
+        "train_step", entry,
+        Expectations(allreduce_only_axes=("data",), max_axis_allreduces=4))
+
+
+def test_rule_donated_reshard():
+    entry = _entry(donated=[{"kind": "all-gather", "axes": ["model"],
+                             "bytes": 2048, "params": [0]}])
+    found = check_program("step", entry, Expectations())
+    assert [f["rule"] for f in found] == ["donated-reshard"]
+
+
+# ------------------------------------------------------------ budget checks
+
+
+def _manifest(programs):
+    return {"version": 1, "tool": "shardcheck",
+            "provenance": {"jax": "0.0", "jaxlib": "0.0"},
+            "mesh": dict(MESH_SIZES),
+            "programs": {
+                name: {"collectives": _entry(colls)["collectives"],
+                       "totals": {}, "full_input_gathers": [],
+                       "donated_param_comms": [], "findings": []}
+                for name, colls in programs.items()}}
+
+
+def test_budget_roundtrip_clean_and_violations():
+    manifest = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 4, 1000),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": []})
+    budget = budget_from_manifest(manifest, tolerance=0.10)
+    violations, notes = check_budget(manifest, budget)
+    assert violations == [] and notes == []
+
+    # bytes growth past tolerance
+    grown = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 4, 1200),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": []})
+    violations, _ = check_budget(grown, budget)
+    assert [v["kind"] for v in violations] == ["bytes-growth"]
+    # within tolerance: clean
+    ok = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 4, 1050),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": []})
+    assert check_budget(ok, budget)[0] == []
+
+    # a NEW collective kind/axes pair
+    new_kind = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 4, 1000),
+                       ("all-reduce", ("model",), 2, 500),
+                       ("all-gather", ("data",), 1, 8)],
+        "decode": []})
+    violations, _ = check_budget(new_kind, budget)
+    assert [v["kind"] for v in violations] == ["new-collective"]
+
+    # count growth (same key)
+    more = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 5, 1000),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": []})
+    violations, _ = check_budget(more, budget)
+    assert [v["kind"] for v in violations] == ["count-growth"]
+
+    # unbudgeted / missing programs
+    extra = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 4, 1000),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": [], "new_prog": []})
+    violations, _ = check_budget(extra, budget)
+    assert [v["kind"] for v in violations] == ["unbudgeted-program"]
+    gone = _manifest({"decode": []})
+    violations, _ = check_budget(gone, budget)
+    assert [v["kind"] for v in violations] == ["missing-program"]
+
+    # shrinkage is a stale note, never a violation
+    less = _manifest({
+        "train_step": [("all-gather", ("fsdp",), 3, 700),
+                       ("all-reduce", ("model",), 2, 500)],
+        "decode": []})
+    violations, notes = check_budget(less, budget)
+    assert violations == [] and any("ratchet" in n or "stale" in n
+                                    for n in notes)
+
+    # mesh mismatch is terminal
+    other = _manifest({"decode": []})
+    other["mesh"] = {"data": 8, "fsdp": 1, "seq": 1, "model": 1}
+    violations, _ = check_budget(other, budget)
+    assert [v["kind"] for v in violations] == ["mesh-mismatch"]
+
+
+# ------------------------------------------------- compile-level integration
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from nanosandbox_tpu.analysis.shardcheck.fleet import build_mesh
+
+    return build_mesh()
+
+
+@pytest.fixture(scope="module")
+def serve_manifest(mesh):
+    from nanosandbox_tpu.analysis.shardcheck.fleet import serve_programs
+    from nanosandbox_tpu.analysis.shardcheck.manifest import build_manifest
+
+    return build_manifest(serve_programs(mesh), mesh)
+
+
+def test_fixture_pair_pins_the_accidental_all_gather(mesh):
+    """The acceptance fixture: dropping the with_sharding_constraint
+    turns a bounded all-to-all into a full-pool all-gather, and
+    shardcheck names it with nonzero bytes."""
+    from nanosandbox_tpu.analysis.shardcheck.fleet import (
+        frontier_slice_programs)
+    from nanosandbox_tpu.analysis.shardcheck.manifest import build_manifest
+
+    good = build_manifest(frontier_slice_programs(mesh, True), mesh)
+    bad = build_manifest(frontier_slice_programs(mesh, False), mesh)
+
+    assert good["findings"] == []
+    assert len(bad["findings"]) == 1
+    f = bad["findings"][0]
+    assert f["rule"] == "accidental-all-gather"
+    assert f["bytes"] == 256 * 64 * 4        # the FULL sharded pool
+    entry = bad["programs"]["frontier_slice_unconstrained"]
+    assert entry["full_input_gathers"][0]["axes"] == ["fsdp"]
+    # The constrained twin exchanges strictly fewer bytes.
+    good_bytes = good["programs"]["frontier_slice"]["totals"]["bytes_moved"]
+    assert 0 < good_bytes < entry["totals"]["bytes_moved"]
+
+
+def test_serve_fleet_manifest_and_committed_budget(serve_manifest):
+    """>= 6 distinct programs incl. decode, >=2 prefill rungs, spec
+    verify + drafter — all pinned comms-free, committed budget clean."""
+    programs = serve_manifest["programs"]
+    assert "decode" in programs
+    assert "spec_verify" in programs
+    assert "drafter_draft" in programs
+    rungs = {name for name in programs if name.startswith("prefill_k")}
+    assert len(rungs) >= 2
+    assert len(programs) >= 6
+    # Today's single-chip contract, stated on the mesh: zero collectives.
+    for name, entry in programs.items():
+        assert entry["collectives"] == {}, (name, entry["collectives"])
+    assert serve_manifest["findings"] == []
+    # replicated accounting: the params went in replicated
+    assert programs["decode"]["replicated_input_bytes"] > 0
+    assert programs["decode"]["sharded_input_bytes_per_device"] == 0
+
+    budget = json.loads(
+        (REPO_ROOT / "budgets" / "serve_cpu8.json").read_text())
+    violations, _ = check_budget(serve_manifest, budget)
+    assert violations == []
+
+
+def test_serve_manifest_provenance_and_memory(serve_manifest):
+    prov = serve_manifest["provenance"]
+    assert prov["device_count"] == 8
+    assert prov["jax"] and prov["jaxlib"]
+    mem = serve_manifest["programs"]["decode"]["memory"]
+    if mem:  # backend-dependent; CPU provides it today
+        assert mem["argument_bytes"] > 0
+
+
+def test_train_fleet_manifest_and_committed_budget(mesh):
+    """Train + eval on the full dp/fsdp/sp/tp mesh: real collectives on
+    the expected axes, zero accidental findings, committed budget
+    clean."""
+    from nanosandbox_tpu.analysis.shardcheck.fleet import train_programs
+    from nanosandbox_tpu.analysis.shardcheck.manifest import build_manifest
+
+    manifest = build_manifest(train_programs(mesh), mesh)
+    programs = manifest["programs"]
+    assert set(programs) == {"train_step", "eval_step"}
+    assert manifest["findings"] == []
+    train = programs["train_step"]
+    # ZeRO-3 gathers on fsdp, ring permutes on seq, TP reduces on model.
+    kinds = {(s["kind"], tuple(s["axes"]))
+             for s in train["collectives"].values()}
+    assert any(k == ("all-gather", ("fsdp",)) for k in kinds)
+    assert any(k[0] == "collective-permute" for k in kinds)
+    assert any(k[0] == "all-reduce" and "model" in k[1] for k in kinds)
+    assert train["totals"]["bytes_moved"] > 0
+    assert train["sharded_input_bytes_per_device"] > 0
+
+    budget = json.loads(
+        (REPO_ROOT / "budgets" / "train_cpu8.json").read_text())
+    violations, notes = check_budget(manifest, budget)
+    assert violations == [], (violations, notes)
+
+
+def test_export_manifest_metrics_gauges(serve_manifest):
+    from nanosandbox_tpu.analysis.shardcheck import (budget_from_manifest,
+                                                     export_manifest_metrics)
+    from nanosandbox_tpu.obs import MetricRegistry, render_prometheus
+
+    reg = MetricRegistry()
+    export_manifest_metrics(budget_from_manifest(serve_manifest), reg)
+    text = render_prometheus(reg)
+    assert "shardcheck_collectives_total" in text
+    assert 'program="decode"' in text
+    assert 'kind="none"' in text       # comms-free programs pin zero
+    reg2 = MetricRegistry()
+    export_manifest_metrics(
+        _manifest({"train_step": [("all-gather", ("fsdp",), 4, 1000)]}),
+        reg2)
+    text2 = render_prometheus(reg2)
+    assert 'kind="all-gather"' in text2 and "4" in text2
+
+
+def test_shardcheck_cli_badge_usage_errors():
+    from nanosandbox_tpu.analysis.shardcheck.cli import main as sc_main
+
+    assert sc_main(["--mesh", "nope"]) == 2
+    assert sc_main(["--fleet", "bogus"]) == 2
+
+
+def test_shardcheck_cli_subcommand_dispatch(tmp_path):
+    """End-to-end through `python -m nanosandbox_tpu.analysis
+    shardcheck` in a fresh process (the CI invocation), on the cheap
+    serve fleet, against the committed budget."""
+    out = tmp_path / "manifest.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "nanosandbox_tpu.analysis", "shardcheck",
+         "--fleet=serve", "--format=json", f"--out={out}",
+         "--budget=budgets/serve_cpu8.json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**__import__("os").environ, "XLA_FLAGS": "",
+             "JAX_PLATFORMS": ""},
+        timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    manifest = json.loads(out.read_text())
+    assert manifest["tool"] == "shardcheck"
+    assert manifest["budget"]["violations"] == []
+    assert "budget budgets/serve_cpu8.json OK" in proc.stdout
