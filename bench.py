@@ -113,6 +113,54 @@ def build_config(kv: dict, *, on_tpu: bool, n_chips: int, tmp: str,
     return cfg, warmup, iters
 
 
+def preflight_decode_impls() -> dict[str, str]:
+    """Per-impl compile status for the flash-decode ladder, the decode
+    twin of preflight_impls(). Runs the SAME probe harness the 'auto'
+    gate uses (flash_decode.compile_probe_check — fp AND
+    int8-with-scales), so the reported verdicts can't drift from what
+    resolve_decode_impl actually checks."""
+    import jax
+
+    from nanosandbox_tpu.ops.flash_decode import compile_probe_check
+
+    status = {"xla": "ok"}  # plain jnp; nothing to probe
+    impls = (["pallas"] if jax.default_backend() == "tpu"
+             else ["pallas_interpret"])
+    for impl in impls:
+        try:
+            compile_probe_check(interpret=impl == "pallas_interpret")
+            status[impl] = "ok"
+        except Exception as e:
+            status[impl] = f"FAIL: {type(e).__name__}: {str(e)[:200]}"
+    return status
+
+
+def estimate_decode_hbm_bytes_per_token(cfg, *, num_slots: int,
+                                        mean_frontier: float,
+                                        kv_dtype: str,
+                                        param_count: int) -> int:
+    """Analytic HBM bytes moved per generated token at full occupancy —
+    the roofline the kv_dtype knob moves. Per token of one slot row:
+    the whole parameter set streams once per STEP and amortizes over
+    num_slots rows; that row's K/V history (mean_frontier positions x
+    n_layer x 2 tensors) streams once for the attention read, plus one
+    position's write. int8 adds 4 scale bytes per (head, position) next
+    to 1-byte values. An estimate, not a measurement: it ignores
+    activations (tiny at T=1) and assumes every slot is occupied."""
+    head_dim = cfg.n_embd // cfg.n_head
+    if kv_dtype == "int8":
+        val_bytes, scale_bytes = 1, 4
+    elif kv_dtype in ("bf16", "bfloat16"):
+        val_bytes, scale_bytes = 2, 0
+    else:
+        val_bytes, scale_bytes = 4, 0
+    pos_bytes = cfg.n_head * (head_dim * val_bytes + scale_bytes)
+    kv_bytes = cfg.n_layer * 2 * pos_bytes * (mean_frontier + 1)
+    import jax.numpy as jnp
+    param_bytes = param_count * jnp.dtype(cfg.compute_dtype).itemsize
+    return int(param_bytes / num_slots + kv_bytes)
+
+
 def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     """Batched-decode tokens/sec through the serve engine, pipelined vs
     synchronous.
@@ -166,6 +214,24 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     max_new = int(kv.get("max_new_tokens", max_new))
     n_requests = int(kv.get("requests", 2 * num_slots))
     mixed = _flag(kv, "mixed")
+    from nanosandbox_tpu.models.gpt import normalize_kv_dtype
+
+    # --kv_dtype benches the requested KV-pool mode as the PRIMARY
+    # engines; when it differs from the baseline mode (--baseline_kv_dtype,
+    # default the serving compute dtype), a baseline-mode pipelined twin
+    # (and, under --spec, a spec twin) runs in the same interleaved
+    # rounds so the JSON records the kv-vs-baseline ratio, greedy token
+    # parity, and spec-acceptance delta — the ISSUE-8 acceptance
+    # numbers (--kv_dtype=int8 --baseline_kv_dtype=fp32 measures the
+    # literal int8-vs-fp32 bar even on a bf16-compute TPU).
+    # --decode_impl pins the flash-decode ladder for EVERY engine (so
+    # the dtype comparison isolates bytes, not impls).
+    kv_dtype = normalize_kv_dtype(kv.get("kv_dtype"))
+    decode_impl = kv.get("decode_impl")
+    default_mode = "bf16" if cfg.compute_dtype == "bfloat16" else "fp32"
+    baseline_kv = normalize_kv_dtype(kv.get("baseline_kv_dtype"))
+    baseline_mode = baseline_kv or default_mode
+    compare_kv = kv_dtype is not None and kv_dtype != baseline_mode
     spec = kv.get("spec", "off")
     if spec not in ("off", "ngram"):
         # ModelDrafter needs a restored checkpoint; the bench initializes
@@ -198,9 +264,10 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 prompt = rng.integers(0, cfg.vocab_size, max(L, 1)).tolist()
             engine.submit(prompt, mnt)
 
-    def build(pipeline: bool, drafter=None):
+    def build(pipeline: bool, drafter=None, kvd=kv_dtype):
         engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
-                        pipeline=pipeline, spec=drafter)
+                        pipeline=pipeline, spec=drafter, kv_dtype=kvd,
+                        decode_impl=decode_impl)
         # Warmup: every (wave rung, bucket) prefill + admit + decode +
         # release program, so no timed window eats an XLA compile. The
         # prompt length must MAP to the bucket being warmed (in
@@ -227,7 +294,11 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         t0 = time.perf_counter()
         results = engine.drain()
         dt = time.perf_counter() - t0
-        return sum(len(r.tokens) for r in results), dt
+        # Submission order == rid order within the round, so sorted
+        # token lists align across engines fed the same workload seed
+        # (the greedy-parity comparison below).
+        toks = [r.tokens for r in sorted(results, key=lambda r: r.rid)]
+        return sum(len(t) for t in toks), dt, toks
 
     # INTERLEAVED repeats, median rate per engine (--repeat=N; 3 by
     # default off --quick): a shared/contended host can swing a single
@@ -236,18 +307,26 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     # median — the PR 2 measurement discipline, now built in.
     repeat = int(kv.get("repeat", 1 if quick else 3))
     engines = {"sync": build(pipeline=False), "pipe": build(pipeline=True)}
+    if compare_kv:
+        engines["kv_base"] = build(pipeline=True, kvd=baseline_kv)
     if spec != "off":
         engines["spec"] = build(pipeline=True,
                                 drafter=NGramDrafter(k=spec_k))
+        if compare_kv:
+            engines["spec_base"] = build(pipeline=True,
+                                         drafter=NGramDrafter(k=spec_k),
+                                         kvd=baseline_kv)
     rates = {name: [] for name in engines}
     gen_total = {name: 0 for name in engines}
     dt_total = {name: 0.0 for name in engines}
+    tokens_by_engine = {name: [] for name in engines}
     for r in range(repeat):
         for name, eng in engines.items():
-            g, d = timed(eng, seed=r)
+            g, d, toks = timed(eng, seed=r)
             rates[name].append(g / d)
             gen_total[name] += g
             dt_total[name] += d
+            tokens_by_engine[name].append(toks)
 
     from statistics import median
 
@@ -255,6 +334,55 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     stats = engine.stats()
     rate = median(rates["pipe"])
     generated, dt = gen_total["pipe"], dt_total["pipe"]
+
+    # Decode-attention + KV-mode signal (ISSUE 8 satellite): the
+    # RESOLVED impl per engine, the flash-decode preflight ladder, and
+    # the analytic HBM bytes/token the kv_dtype knob moves. The mean
+    # attended frontier under this workload: prompts draw uniform from
+    # [1, max_len - max_new) and a request's decode walk averages half
+    # its budget — which under --mixed is itself uniform in
+    # [max_new/4, max_new] (mean 0.625 * max_new), not max_new.
+    from nanosandbox_tpu.models.gpt import count_params
+
+    mean_budget = (max(1, max_new // 4) + max_new) / 2 if mixed else max_new
+    mean_frontier = (1 + max(2, max_len - max_new)) / 2 + mean_budget / 2
+    n_params = count_params({"params": params})
+    kv_extra = {
+        "kv_dtype": engines["pipe"].kv_dtype,
+        "decode_attention_impl": engines["pipe"].decode_impl,
+        "decode_impl_status": preflight_decode_impls(),
+        "estimated_hbm_bytes_per_token": estimate_decode_hbm_bytes_per_token(
+            cfg, num_slots=num_slots, mean_frontier=mean_frontier,
+            kv_dtype=engines["pipe"].kv_dtype, param_count=n_params),
+    }
+    if compare_kv:
+        base_rate = median(rates["kv_base"])
+        # Greedy token parity vs the default-mode pipelined twin: same
+        # workload seeds, deterministic engines, so the match fraction
+        # is a pure function of the quantization drift.
+        total = matched = 0
+        for round_a, round_b in zip(tokens_by_engine["pipe"],
+                                    tokens_by_engine["kv_base"]):
+            for ta, tb in zip(round_a, round_b):
+                total += max(len(ta), len(tb))
+                matched += sum(x == y for x, y in zip(ta, tb))
+        kv_extra.update({
+            "baseline_kv_dtype": engines["kv_base"].kv_dtype,
+            "baseline_tokens_per_sec": base_rate,
+            "kv_vs_baseline": median(rates["pipe"]) / base_rate,
+            "kv_greedy_parity": matched / max(total, 1),
+            "estimated_hbm_bytes_per_token_baseline":
+                estimate_decode_hbm_bytes_per_token(
+                    cfg, num_slots=num_slots, mean_frontier=mean_frontier,
+                    kv_dtype=engines["kv_base"].kv_dtype,
+                    param_count=n_params),
+        })
+        if kv_dtype == "int8" and baseline_mode == "fp32":
+            # The alias only when it is TRUE under its own name — on a
+            # bf16-compute host pass --baseline_kv_dtype=fp32 to get it;
+            # otherwise the honest keys are kv_vs_baseline +
+            # baseline_kv_dtype.
+            kv_extra["int8_vs_fp32"] = kv_extra["kv_vs_baseline"]
 
     spec_extra = {"spec": spec}
     if spec != "off":
@@ -273,6 +401,19 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "spec_verify_steps": sstats["spec"]["verify_steps"],
             "spec_tokens_generated": gen_total["spec"],
         })
+        if compare_kv:
+            # Acceptance non-regression under the quantized pool: the
+            # default-mode spec twin ran the same interleaved rounds, so
+            # the delta is attributable to kv_dtype alone (ISSUE-8
+            # acceptance: within 1% of fp32).
+            acc = sstats["spec_acceptance_rate"]
+            acc_base = engines["spec_base"].stats()["spec_acceptance_rate"]
+            spec_extra.update({
+                "spec_acceptance_rate_baseline": acc_base,
+                "spec_acceptance_delta": (
+                    None if acc is None or acc_base is None
+                    else acc - acc_base),
+            })
 
     from nanosandbox_tpu.analysis.shardcheck import provenance
 
@@ -320,6 +461,7 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "tpot_s": stats["tpot_s"],
             "queue_wait_steps_mean": stats["queue_wait_steps_mean"],
             "repetitive": repetitive,
+            **kv_extra,
             **spec_extra,
         },
         **obs_extra,

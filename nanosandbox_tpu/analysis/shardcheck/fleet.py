@@ -79,7 +79,12 @@ def train_programs(mesh) -> List:
 
 def serve_programs(mesh) -> List:
     """Tiny-Engine ProgramSpecs (decode + prefill grid + spec verify +
-    ModelDrafter draft/draft_prefill) on ``mesh``, all replicated."""
+    ModelDrafter draft/draft_prefill) on ``mesh``, all replicated — in
+    BOTH KV-pool modes: the default full-precision engine and an
+    int8-KV twin (kv_dtype='int8', flash-decode in interpret mode so
+    the analyzed decode program contains this kernel's actual ops).
+    The *_kv8 programs pin that quantize-on-write, fused-dequant decode
+    stays comms-free exactly like the fp pool."""
     import jax
     import jax.numpy as jnp
 
@@ -103,7 +108,12 @@ def serve_programs(mesh) -> List:
     engine = Engine(model, params, num_slots=4, max_len=32,
                     prefill_buckets=(16, 32),
                     spec=ModelDrafter(dmodel, dparams, k=3))
-    return engine.shardcheck_programs(mesh)
+    engine_kv8 = Engine(model, params, num_slots=4, max_len=32,
+                        prefill_buckets=(16, 32),
+                        spec=ModelDrafter(dmodel, dparams, k=3),
+                        kv_dtype="int8", decode_impl="pallas_interpret")
+    return (engine.shardcheck_programs(mesh)
+            + engine_kv8.shardcheck_programs(mesh))
 
 
 def frontier_slice_programs(mesh, constrained: bool) -> List:

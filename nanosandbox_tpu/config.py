@@ -320,6 +320,14 @@ class GPTConfig:
     ring_block_impl: str = "auto"
     remat: bool = False
     remat_policy: str = "save_attention"
+    # Cached-decode attention impl for the T=1 per-row hot path
+    # (ops/flash_decode.py ladder): 'auto' = Pallas flash-decode when the
+    # compile probe passes, XLA otherwise; 'pallas' / 'pallas_interpret'
+    # / 'xla' pin it. Training never reads this field.
+    decode_impl: str = "auto"
+
+    def replace(self, **kw: Any) -> "GPTConfig":
+        return dataclasses.replace(self, **kw)
 
     @classmethod
     def from_train_config(cls, cfg: TrainConfig, vocab_size: int) -> "GPTConfig":

@@ -63,23 +63,40 @@ class CausalSelfAttention(nn.Module):
         new_cache = None
         if cache is not None:
             # Incremental decode: write this call's K/V into the cache
-            # buffer at cache_index and attend q against the whole buffer.
-            # Decode shapes are tiny (T=1 per step after prefill), so plain
-            # XLA dots are the right tool — the flash kernel's blocking
-            # buys nothing at (1, Tc) and its 128-multiple block shapes
-            # don't fit a growing frontier. Unwritten buffer tail is
-            # masked off by position (kpos > qpos), so the zeros never
-            # contribute. Falls through to the SHARED c_proj below — the
-            # projection must be declared exactly once so decode can never
-            # desync from the trained parameter's definition.
+            # buffer at cache_index and attend q against the buffer.
+            # The T=1 per-row hot path dispatches to the fused flash-
+            # decode Pallas kernel (ops/flash_decode.py) when the config
+            # selects it; everything else (T = k+1 verify blocks, scalar-
+            # index prefill, the XLA fallback) runs the masked-score
+            # path below. Unwritten buffer tail is masked off by
+            # position (kpos > qpos), so the zeros never contribute.
+            # Falls through to the SHARED c_proj below — the projection
+            # must be declared exactly once so decode can never desync
+            # from the trained parameter's definition.
             if not deterministic and cfg.dropout > 0.0:
                 raise ValueError("cached decode is inference-only; "
                                  "call with deterministic=True")
             from jax import lax
 
-            ck, cv = cache
+            from nanosandbox_tpu.ops.flash_decode import (
+                flash_decode, quantize_kv_rows, resolve_decode_impl)
+
+            # int8 KV mode (init_cache kv_dtype='int8'): the layer cache
+            # is (K int8, V int8, k_scale f32, v_scale f32) with one
+            # scale per (row, head, position) — quantize-on-write, so
+            # quantized K/V is the only representation the pool holds.
+            quantized = len(cache) == 4
+            if quantized:
+                ck, cv, cks, cvs = cache
+                k_w, ks_w = quantize_kv_rows(k)      # (B, H, T, D)->(B,H,T)
+                v_w, vs_w = quantize_kv_rows(v)
+            else:
+                ck, cv = cache
+                cks = cvs = None
+                k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
             Tc = ck.shape[2]
-            if getattr(cache_index, "ndim", 0) == 1:
+            per_row = getattr(cache_index, "ndim", 0) == 1
+            if per_row:
                 # Per-row frontiers (serve engine's slot pool): each batch
                 # row b writes its K/V at its OWN position cache_index[b]
                 # and attends up to it. vmap over the batch dim turns the
@@ -91,6 +108,9 @@ class CausalSelfAttention(nn.Module):
                     # row, unchanged from the pre-speculative engine.
                     def _row_write(buf, x, i):
                         return lax.dynamic_update_slice(buf, x, (0, i, 0))
+
+                    def _row_write_scale(buf, x, i):
+                        return lax.dynamic_update_slice(buf, x, (0, i))
                 else:
                     # Speculative-verify path: a fixed (T = k+1)-column
                     # block per row. Scatter with mode='drop', NOT
@@ -102,26 +122,79 @@ class CausalSelfAttention(nn.Module):
                     def _row_write(buf, x, i):
                         cols = i + jnp.arange(T)
                         return buf.at[:, cols, :].set(x, mode="drop")
-                ck = jax.vmap(_row_write)(ck, k.astype(ck.dtype), cache_index)
-                cv = jax.vmap(_row_write)(cv, v.astype(cv.dtype), cache_index)
+
+                    def _row_write_scale(buf, x, i):
+                        cols = i + jnp.arange(T)
+                        return buf.at[:, cols].set(x, mode="drop")
+                ck = jax.vmap(_row_write)(ck, k_w, cache_index)
+                cv = jax.vmap(_row_write)(cv, v_w, cache_index)
+                if quantized:
+                    cks = jax.vmap(_row_write_scale)(cks, ks_w, cache_index)
+                    cvs = jax.vmap(_row_write_scale)(cvs, vs_w, cache_index)
                 qpos = cache_index[:, None] + jnp.arange(T)[None, :]  # (B, T)
             else:
-                ck = lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
-                cv = lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
+                ck = lax.dynamic_update_slice(ck, k_w, (0, 0, cache_index, 0))
+                cv = lax.dynamic_update_slice(cv, v_w, (0, 0, cache_index, 0))
+                if quantized:
+                    cks = lax.dynamic_update_slice(cks, ks_w,
+                                                   (0, 0, cache_index))
+                    cvs = lax.dynamic_update_slice(cvs, vs_w,
+                                                   (0, 0, cache_index))
                 qpos = (cache_index + jnp.arange(T))[None, :]  # (1, T) global
-            # (B|1, 1, T, Tc): kpos <= qpos. The unwritten/stale buffer
-            # tail beyond each row's frontier is masked off, so garbage
-            # K/V from a previous slot occupant never contributes.
-            mask = jnp.arange(Tc)[None, None, None, :] <= qpos[:, None, :, None]
-            scores = jnp.einsum("bhtd,bhsd->bhts", q, ck,
-                                preferred_element_type=jnp.float32)
-            scores = scores * (1.0 / head_dim ** 0.5)
-            scores = jnp.where(mask, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            y = jnp.einsum("bhts,bhsd->bhtd", probs.astype(cv.dtype), cv)
-            new_cache = (ck, cv)
+            decode_impl = resolve_decode_impl(
+                getattr(cfg, "decode_impl", "auto"))
+            if per_row and T == 1 and decode_impl != "xla":
+                # Fused single-query flash decode: one pass over each
+                # row's K/V blocks up to its own frontier, int8 dequant
+                # folded into scores/probs so quantized K/V never
+                # materializes in fp (ops/flash_decode.py).
+                y = flash_decode(
+                    q[:, :, 0, :], ck, cv, cache_index + 1,
+                    k_scale=cks, v_scale=cvs,
+                    sm_scale=1.0 / head_dim ** 0.5,
+                    interpret=(decode_impl == "pallas_interpret"))[
+                        :, :, None, :]
+            else:
+                # Masked-score XLA path. When cache_index is a STATIC int
+                # (prefill / sample.generate's first pass) the attended
+                # range is bounded to the known frontier instead of the
+                # full buffer: positions past cache_index + T can only
+                # ever be masked, so slicing them off saves their score
+                # FLOPs and K/V bytes outright (bit-identical output —
+                # the masked columns' softmax mass is exactly 0). Traced
+                # indices (the per-row decode/verify paths) keep the full
+                # buffer: their frontier is data, not shape.
+                span = Tc
+                if isinstance(cache_index, int):
+                    span = min(cache_index + T, Tc)
+                ck_a, cv_a = ck[:, :, :span], cv[:, :, :span]
+                # (B|1, 1, T, span): kpos <= qpos. The unwritten/stale
+                # buffer tail beyond each row's frontier is masked off,
+                # so garbage K/V from a previous slot occupant never
+                # contributes.
+                mask = (jnp.arange(span)[None, None, None, :]
+                        <= qpos[:, None, :, None])
+                scores = jnp.einsum(
+                    "bhtd,bhsd->bhts", q,
+                    ck_a.astype(q.dtype) if quantized else ck_a,
+                    preferred_element_type=jnp.float32)
+                scores = scores * (1.0 / head_dim ** 0.5)
+                if quantized:
+                    # Per-position scales fold into the score/probability
+                    # tensors (scale is constant across the head_dim
+                    # contraction) — the same dequant-by-folding contract
+                    # as the flash kernel, so the two paths agree.
+                    scores = scores * cks[:, :, None, :span]
+                scores = jnp.where(mask, scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                if quantized:
+                    probs_v = (probs * cvs[:, :, None, :span]).astype(q.dtype)
+                    y = jnp.einsum("bhts,bhsd->bhtd", probs_v,
+                                   cv_a.astype(q.dtype))
+                else:
+                    y = jnp.einsum("bhts,bhsd->bhtd", probs.astype(cv.dtype),
+                                   cv_a)
+            new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
         elif cfg.attention_impl == "ring":
             # Sequence-parallel ring attention: T is sharded over the mesh's
             # seq axis; K/V chunks rotate over ICI (ops/ring_attention.py).
@@ -378,21 +451,58 @@ class GPT(nn.Module):
         return logits
 
 
+KV_DTYPES = ("fp32", "bf16", "int8")
+
+
+def normalize_kv_dtype(kv_dtype) -> str | None:
+    """Canonicalize a --kv_dtype flag value: None/''/'auto' -> None (use
+    the compute dtype, the pre-int8 default), else one of KV_DTYPES."""
+    if kv_dtype in (None, "", "auto"):
+        return None
+    alias = {"fp32": "fp32", "float32": "fp32",
+             "bf16": "bf16", "bfloat16": "bf16", "int8": "int8"}
+    norm = alias.get(str(kv_dtype))
+    if norm is None:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
+                         f"(expected one of {KV_DTYPES})")
+    return norm
+
+
 def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
-               dtype: Any = None) -> list:
+               dtype: Any = None, kv_dtype=None) -> list:
     """Per-layer (K, V) decode buffers, shape (B, H, max_len, head_dim).
 
     max_len caps at block_size — the learned positional table (wpe) defines
     positions no further, matching nanoGPT's context-cropping contract.
     Stored in compute_dtype by default (bf16 on TPU): halves cache HBM and
     matches the dtype K/V are produced in, so writes are cast-free.
-    """
+
+    kv_dtype ('fp32' | 'bf16' | 'int8', see normalize_kv_dtype) overrides
+    the storage mode. 'int8' switches each layer to a 4-tuple
+    (K int8, V int8, k_scale f32 (B, H, max_len), v_scale f32 likewise):
+    per-(row, head, position) symmetric scales, quantize-on-write in the
+    attention cache path (models above) and in scatter_cache_rows, so
+    fp K/V never reaches the pool — 2x (vs bf16) / 4x (vs fp32) less HBM
+    per cached token, i.e. 2x the concurrent slots at constant HBM and
+    proportionally less decode read traffic."""
     if max_len > cfg.block_size:
         raise ValueError(
             f"cache length {max_len} > block_size {cfg.block_size}")
+    kvd = normalize_kv_dtype(kv_dtype)
     head_dim = cfg.n_embd // cfg.n_head
-    dtype = jnp.dtype(dtype or cfg.compute_dtype)
     shape = (batch_size, cfg.n_head, max_len, head_dim)
+    if kvd == "int8":
+        sshape = (batch_size, cfg.n_head, max_len)
+        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(cfg.n_layer)]
+    if kvd == "fp32":
+        dtype = jnp.float32
+    elif kvd == "bf16":
+        dtype = jnp.bfloat16
+    else:
+        dtype = jnp.dtype(dtype or cfg.compute_dtype)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.n_layer)]
 
@@ -405,9 +515,38 @@ def scatter_cache_rows(pool: list, rows: list, slots: jax.Array) -> list:
     engine's ladder-padding rows) writes nowhere, unlike
     dynamic_update_slice whose index CLAMP would silently overwrite the
     last real slot row. Stale columns past L are hidden by the per-row
-    causal mask until the new occupant's decode overwrites them."""
+    causal mask until the new occupant's decode overwrites them.
+
+    An int8 pool (4-tuple layers) accepts fp rows — they are quantized
+    HERE, inside the compiled prefill program, so a prefill wave's K/V
+    lands already-quantized (the prefill forward itself keeps full
+    precision; only the pool representation narrows). Rows that are
+    already int8 4-tuples (an int8 temp cache) scatter as-is."""
+    from nanosandbox_tpu.ops.flash_decode import quantize_kv_rows
+
     out = []
-    for (pk, pv), (ck, cv) in zip(pool, rows):
+    for pool_layer, row_layer in zip(pool, rows):
+        if len(pool_layer) == 4:
+            pk, pv, pks, pvs = pool_layer
+            if len(row_layer) == 4:
+                ck, cv, cks, cvs = row_layer
+            else:
+                ck, cv = row_layer
+                ck, cks = quantize_kv_rows(ck)
+                cv, cvs = quantize_kv_rows(cv)
+            L = ck.shape[2]
+            pk = pk.at[slots, :, :L, :].set(ck, mode="drop")
+            pv = pv.at[slots, :, :L, :].set(cv, mode="drop")
+            pks = pks.at[slots, :, :L].set(cks, mode="drop")
+            pvs = pvs.at[slots, :, :L].set(cvs, mode="drop")
+            out.append((pk, pv, pks, pvs))
+            continue
+        ck, cv = row_layer[0], row_layer[1]
+        if len(row_layer) == 4:
+            raise ValueError(
+                "cannot scatter int8 rows into a full-precision pool; "
+                "build the pool with init_cache(kv_dtype='int8')")
+        pk, pv = pool_layer
         L = ck.shape[2]
         pk = pk.at[slots, :, :L, :].set(ck.astype(pk.dtype), mode="drop")
         pv = pv.at[slots, :, :L, :].set(cv.astype(pv.dtype), mode="drop")

@@ -1,0 +1,368 @@
+"""Fused single-query flash-decode over the serve engine's slot KV pool.
+
+The decode hot loop's attention is HBM-bandwidth-bound: every token of
+every slot streams that slot's whole K/V history from HBM once. The
+generic cached path in models/gpt.py pays that stream twice over —
+scores materialize against the full ``max_len`` buffer in fp32, the
+probability tensor round-trips through XLA fusions — and, with an int8
+pool, would need a dequantized fp copy of the cache before the first
+dot. This kernel is the decode twin of ops/attention.py's training
+kernel: ONE pass over each row's K/V blocks with an online softmax, the
+frontier mask read from the device-resident per-row ``pos`` state (never
+attend past a row's own frontier), and int8→fp dequantization FUSED into
+the score/probability math so quantized K/V is the only cache
+representation that ever touches HBM.
+
+Dequant-by-folding (why no fp K/V copy exists even transiently):
+the per-position scales are constant across the head_dim contraction, so
+
+    q · (k_int * k_scale) == (q · k_int) * k_scale      (fold into scores)
+    p · (v_int * v_scale) == (p * v_scale) · v_int      (fold into probs)
+
+Both folds are lane-dim (1, block_k) elementwise multiplies — no
+cross-lane relayout, no (block_k, 1) scale column Mosaic can't express.
+Scales are per (slot, head, position): one fp32 scalar per ≤128-lane
+K/V row, i.e. per block-of-128-lanes of pool data (head_dim ≤ 128
+everywhere this repo runs), ~6% byte overhead at D=64 against the 2-4x
+the int8 values save.
+
+Layouts: q (B, H, D) — the single query per row; k/v (B, H, L, D) in
+fp32/bf16, or int8 with (B, H, L) f32 scales; lengths (B,) int32 = the
+number of valid positions (the engine passes pos + 1: attend kpos <=
+pos). Heads fold into the grid's row dim exactly like the training
+kernel's (B*H, ...) flattening; each grid step owns one (slot, head)
+row and walks only ceil(length / block_k) K/V blocks — blocks past the
+frontier are skipped at the compute level (the fori_loop bound is the
+row's own frontier), and the diagonal-split idiom from the training
+kernel keeps the mask VPU work off the fully-valid blocks. DMA-level
+block skipping (not fetching past-frontier blocks at all) belongs to
+the ROADMAP-2 paged pool, whose block table this kernel is built to
+page over.
+
+Impl ladder (the training kernel's idiom, --decode_impl):
+  'auto'             — Pallas when the compile probe passes (TPU),
+                       warn_once + XLA otherwise;
+  'pallas'           — pin the compiled Mosaic kernel;
+  'pallas_interpret' — the same kernel through the Pallas interpreter,
+                       so CPU CI exercises this file's exact math;
+  'xla'              — the masked-score reference (also the fallback
+                       models/gpt.py keeps inline for T > 1 verify
+                       blocks and scalar-index prefill).
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+# Sublane quantum that tiles legally for every KV dtype this kernel
+# accepts (f32 needs 8, bf16 16, int8 32 — see the Pallas tiling table).
+SUBLANE_QUANTUM = 32
+DEFAULT_BLOCK_K = 256
+
+__all__ = ["flash_decode", "xla_decode_attention", "resolve_decode_impl",
+           "decode_compile_probe", "compile_probe_check",
+           "quantize_kv_rows", "DECODE_IMPLS"]
+
+DECODE_IMPLS = ("auto", "pallas", "pallas_interpret", "xla")
+
+
+# ---------------------------------------------------------------------------
+# Quantization (shared with models/gpt.py's cache writes)
+# ---------------------------------------------------------------------------
+
+def quantize_kv_rows(x: jax.Array):
+    """Per-row symmetric int8 quantization over the trailing (head_dim)
+    axis: returns (values int8 same shape, scales f32 x.shape[:-1]).
+
+    One scale per K/V row — for head_dim <= 128 a row is one <=128-lane
+    register block, so this is the per-block-of-128 granularity the
+    kernel folds into scores/probs. Symmetric round-to-nearest; the
+    round-trip error per element is bounded by scale/2 =
+    max|row| / 254 (pinned by tests/test_flash_decode.py). All-zero
+    rows (parked slots, unwritten tail) quantize to zeros exactly."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (the fallback + the test oracle)
+# ---------------------------------------------------------------------------
+
+def xla_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         lengths: jax.Array, *, k_scale=None, v_scale=None,
+                         sm_scale: float | None = None) -> jax.Array:
+    """Masked single-query attention in plain jnp: q (B, H, D) against
+    k/v (B, H, L, D) with per-row valid ``lengths`` (B,). int8 k/v take
+    per-position scales (B, H, L), folded into scores/probs exactly as
+    the kernel folds them — the two impls share one numeric contract."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    dtype = q.dtype
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if k_scale is not None:
+        s = s * k_scale
+    s = s * sm_scale
+    mask = jnp.arange(k.shape[2])[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale
+    return jnp.einsum("bhs,bhsd->bhd", p,
+                      v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _flash_decode_kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         o_ref, *, block_k: int, sm_scale: float,
+                         heads: int, quantized: bool):
+    """One grid step == one (slot, head) row: walk the row's K/V blocks
+    up to its OWN frontier with an online softmax. Same split-loop idiom
+    as the training kernel: blocks fully inside the frontier skip the
+    iota/compare mask (pure VPU cost), only the partial frontier block
+    masks."""
+    b = pl.program_id(0)
+    length = len_ref[b // heads]          # this row's valid positions
+    # Dot dtype: int8 K/V feed the MXU in the QUERY's dtype (integers up
+    # to 127 are exact in bf16) with f32 accumulation; full-precision
+    # pools use the WIDER of (query, pool) — an fp32 pool under a bf16
+    # query must not silently lose its precision on the flash path (the
+    # XLA reference keeps fp32 operands there too).
+    dot_dt = (q_ref.dtype if quantized
+              else jnp.promote_types(q_ref.dtype, k_ref.dtype))
+    q = q_ref[0].astype(dot_dt)           # (1, D)
+    num_kb = lax.div(length + block_k - 1, block_k)
+    num_kb_inner = lax.div(length, block_k)   # fully-valid blocks
+
+    def body(j, carry, *, masked: bool):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        # int8 K enters the dot WITHOUT its scale; the scale folds into
+        # the (1, block_k) score row below — a lane-dim multiply, never
+        # a dequantized K tile.
+        s = lax.dot_general(q, k.astype(dot_dt), (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (1, bk)
+        if quantized:
+            s = s * ks_ref[0, :, pl.ds(j * block_k, block_k)]
+        s = s * sm_scale
+        if masked:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # (1, 1)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        if quantized:
+            # v's scale folds into the probability row (p * s) @ v_int —
+            # the normalizer l above sums the UNSCALED p, so the final
+            # acc / l division is exactly softmax(s) @ (v_int * scale).
+            p = p * vs_ref[0, :, pl.ds(j * block_k, block_k)]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        acc_new = acc * alpha + lax.dot_general(
+            p.astype(dot_dt), v.astype(dot_dt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((1, q_ref.shape[2]), jnp.float32),
+        jnp.full((1, 1), NEG_INF, jnp.float32),
+        jnp.zeros((1, 1), jnp.float32),
+    )
+    carry = lax.fori_loop(0, num_kb_inner,
+                          functools.partial(body, masked=False), init)
+    acc, m, l = lax.fori_loop(num_kb_inner, num_kb,
+                              functools.partial(body, masked=True), carry)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _clamp_block_k(L: int, block_k: int) -> tuple[int, int]:
+    """(block_k, Lp): the largest SUBLANE_QUANTUM multiple <= the request
+    that the padded pool length divides into — same divide-don't-pad
+    policy as the training kernel's _clamp_blocks, on the 32-row quantum
+    every KV dtype tiles at."""
+    Lq = -(-L // SUBLANE_QUANTUM) * SUBLANE_QUANTUM
+    b = max(SUBLANE_QUANTUM,
+            block_k // SUBLANE_QUANTUM * SUBLANE_QUANTUM)
+    b = min(b, Lq)
+    while Lq % b:
+        b -= SUBLANE_QUANTUM  # terminates at SUBLANE_QUANTUM
+    return b, Lq
+
+
+def decode_pad_copies(max_len: int, head_dim: int) -> bool:
+    """True when flash_decode must PAD — i.e. copy — the pool on every
+    call: max_len off the 32-row sublane quantum, or a head_dim outside
+    the verified-unpadded set (64 / 128-multiples). On the HBM-bound
+    decode hot path that copy roughly doubles per-step traffic, so the
+    engine warns at construction instead of paying it silently."""
+    return (max_len % SUBLANE_QUANTUM != 0
+            or not (head_dim == 64 or head_dim % 128 == 0))
+
+
+def flash_decode(q: jax.Array, k: jax.Array, v: jax.Array,
+                 lengths: jax.Array, *, k_scale=None, v_scale=None,
+                 sm_scale: float | None = None,
+                 block_k: int = DEFAULT_BLOCK_K,
+                 interpret: bool = False) -> jax.Array:
+    """Single-query flash attention over per-row frontiers (see module
+    docstring for layouts). Returns (B, H, D) in q's dtype."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be supplied together")
+    if k_scale is not None and (k.dtype != jnp.int8 or v.dtype != jnp.int8):
+        raise ValueError(
+            f"scales supplied for non-int8 k/v ({k.dtype}/{v.dtype})")
+    quantized = k_scale is not None
+    B, H, L, D = k.shape
+    if q.shape != (B, H, D):
+        raise ValueError(f"q shape {q.shape} != {(B, H, D)}")
+    block_k, Lp = _clamp_block_k(L, block_k)
+    # head_dim padding: same verified rule as the training kernel
+    # (ops/attention.py _pad_qkv) — 64 lanes and 128-multiples run
+    # unpadded, anything else pads to the 128-lane tile.
+    pad_D = 0 if (D == 64 or D % 128 == 0) else (-D) % 128
+    pad_L = Lp - L
+    if pad_D:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, pad_D)])
+    if pad_D or pad_L:
+        pads = [(0, 0), (0, 0), (0, pad_L), (0, pad_D)]
+        k, v = jnp.pad(k, pads), jnp.pad(v, pads)
+    Dp = D + pad_D
+    qf = q.reshape(B * H, 1, Dp)
+    kf = k.reshape(B * H, Lp, Dp)
+    vf = v.reshape(B * H, Lp, Dp)
+    if k_scale is not None:
+        spad = [(0, 0), (0, 0), (0, pad_L)]
+        ksf = jnp.pad(k_scale.astype(jnp.float32), spad).reshape(
+            B * H, 1, Lp)
+        vsf = jnp.pad(v_scale.astype(jnp.float32), spad).reshape(
+            B * H, 1, Lp)
+    else:
+        # Zero-size dummy operands would need their own BlockSpec rules;
+        # a (B*H, 1, SUBLANE_QUANTUM-free) tiny array keeps the operand
+        # list fixed across modes at negligible cost.
+        ksf = vsf = jnp.ones((B * H, 1, LANES), jnp.float32)
+    Ls = ksf.shape[2]
+
+    kernel = functools.partial(
+        _flash_decode_kernel, block_k=block_k, sm_scale=sm_scale,
+        heads=H, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Dp), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, Lp, Dp), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, Lp, Dp), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Ls), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Ls), lambda b: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Dp), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, Dp), q.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), qf, kf, vf, ksf, vsf)
+    return out.reshape(B, H, Dp)[:, :, :D]
+
+
+# ---------------------------------------------------------------------------
+# Dispatch: probe + impl ladder
+# ---------------------------------------------------------------------------
+
+_PROBE: dict[str, bool] = {}
+
+
+def _backend() -> str:
+    return jax.default_backend()
+
+
+def compile_probe_check(*, interpret: bool = False) -> None:
+    """AOT lower+compile the kernel on tiny shapes in BOTH kv modes (fp
+    and int8-with-scales), raising on failure. The ONE probe harness —
+    decode_compile_probe (the 'auto' gate) and bench.py's
+    preflight_decode_impls both call it, so the shapes the ladder is
+    judged on can never drift between the two."""
+    dt = jnp.float32 if interpret else jnp.bfloat16
+    q = jax.ShapeDtypeStruct((2, 2, 64), dt)
+    kv = jax.ShapeDtypeStruct((2, 2, 256, 64), dt)
+    kv8 = jax.ShapeDtypeStruct((2, 2, 256, 64), jnp.int8)
+    sc = jax.ShapeDtypeStruct((2, 2, 256), jnp.float32)
+    ln = jax.ShapeDtypeStruct((2,), jnp.int32)
+
+    def fp(q, k, v, n):
+        return flash_decode(q, k, v, n, interpret=interpret)
+
+    def q8(q, k, v, n, ks, vs):
+        return flash_decode(q, k, v, n, k_scale=ks, v_scale=vs,
+                            interpret=interpret)
+
+    jax.jit(fp).lower(q, kv, kv, ln).compile()
+    jax.jit(q8).lower(q, kv8, kv8, ln, sc, sc).compile()
+
+
+def decode_compile_probe() -> bool:
+    """True iff the flash-decode kernel compiles on the current default
+    backend, in BOTH kv modes — 'auto' must not promise a fallback it
+    only checked for one mode. Compile-only AOT on tiny shapes, cached
+    per process per backend, exactly like ops/attention.py's
+    pallas_compile_probe."""
+    backend = _backend()
+    if backend in _PROBE:
+        return _PROBE[backend]
+    if backend != "tpu":
+        _PROBE[backend] = False
+        return False
+    try:
+        compile_probe_check()
+        _PROBE[backend] = True
+    except Exception as e:  # Mosaic lowering / compile failure
+        warnings.warn(
+            "Pallas flash-decode failed to compile on this TPU; decode "
+            f"attention falls back to the XLA path. Error: {e}")
+        _PROBE[backend] = False
+    return _PROBE[backend]
+
+
+def resolve_decode_impl(impl: str) -> str:
+    """'auto' -> 'pallas' when the probe passes, else 'xla' — with a
+    warn_once when a TPU lands on the fallback (a silent 2x decode
+    slowdown is exactly the failure mode that must not be silent).
+    Explicit impls pass through untouched (never probed)."""
+    if impl not in DECODE_IMPLS:
+        raise ValueError(f"unknown decode impl: {impl!r} "
+                         f"(expected one of {DECODE_IMPLS})")
+    if impl != "auto":
+        return impl
+    if decode_compile_probe():
+        return "pallas"
+    if _backend() == "tpu":
+        from nanosandbox_tpu.utils.metrics import warn_once
+
+        warn_once(
+            "flash-decode-xla-fallback",
+            "[serve] flash-decode Pallas kernel unavailable on this TPU "
+            "(compile probe failed) — decode attention is running on the "
+            "XLA fallback path, ~2x the HBM traffic per token. Pin "
+            "--decode_impl=xla to silence, or fix the kernel regression.")
+    return "xla"

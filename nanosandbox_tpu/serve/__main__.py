@@ -34,6 +34,19 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_pipeline", action="store_true",
                     help="synchronous decode loop (debugging baseline); "
                          "default keeps one decode step in flight")
+    ap.add_argument("--kv_dtype", default=None,
+                    choices=("fp32", "bf16", "int8"),
+                    help="KV-pool storage mode (default: the serving "
+                         "compute dtype). int8 stores per-position "
+                         "scales alongside the values: ~2x less HBM per "
+                         "cached token than bf16, so 2x the slots at "
+                         "constant HBM and ~2x less decode read traffic")
+    ap.add_argument("--decode_impl", default=None,
+                    choices=("auto", "pallas", "pallas_interpret", "xla"),
+                    help="cached-decode attention impl (flash-decode "
+                         "ladder, ops/flash_decode.py); 'auto' probes "
+                         "the Pallas kernel and warn_once-falls back to "
+                         "xla. The resolved impl is exported on /metrics")
     ap.add_argument("--spec", default="off",
                     help="speculative decoding: 'ngram' (prompt-lookup "
                          "drafting) or 'model:<out_dir>' (smaller "
@@ -107,7 +120,8 @@ def main(argv: list[str] | None = None) -> None:
                                 data_dir=args.data_dir)
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
                     max_len=args.max_len or None,
-                    pipeline=not args.no_pipeline, spec=drafter)
+                    pipeline=not args.no_pipeline, spec=drafter,
+                    kv_dtype=args.kv_dtype, decode_impl=args.decode_impl)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -172,7 +186,8 @@ def main(argv: list[str] | None = None) -> None:
     server = make_server(args.host, args.port, loop, tok.encode,
                          lambda ids: tok.decode([int(t) for t in ids]))
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
-          f"{engine.max_len} ctx; prefill buckets "
+          f"{engine.max_len} ctx (kv_dtype={engine.kv_dtype}, "
+          f"decode_impl={engine.decode_impl}); prefill buckets "
           f"{engine.sched.buckets}; listening on "
           f"{args.host}:{args.port} (POST /generate, GET /healthz "
           "/stats /metrics /trace, POST /profile)",

@@ -124,11 +124,21 @@ class ModelDrafter:
     # -- engine-driven lifecycle ------------------------------------------
 
     def build(self, *, target_cfg, num_slots: int, max_len: int,
-              n_prefill_programs: int, registry, on_accel: bool) -> dict:
+              n_prefill_programs: int, registry, on_accel: bool,
+              kv_dtype=None, decode_impl=None) -> dict:
         """Allocate the drafter pool + compile draft/prefill under the
         engine's trace registry; returns the program budget entries to
-        merge into Engine.max_programs()."""
+        merge into Engine.max_programs(). kv_dtype mirrors the engine's
+        pool mode onto the drafter's own pool ('int8' halves it too);
+        decode_impl (the ENGINE's setting) overrides the drafter
+        model's own ladder rung, so an operator pinning the engine off
+        a broken kernel pins the drafter's draft steps with it."""
         import jax
+
+        if decode_impl is not None and decode_impl != self.model.cfg.decode_impl:
+            self.model = type(self.model)(
+                cfg=self.model.cfg.replace(decode_impl=decode_impl),
+                mesh=getattr(self.model, "mesh", None))
 
         from nanosandbox_tpu.models.gpt import init_cache
 
@@ -145,7 +155,7 @@ class ModelDrafter:
                 "the target can reach")
         self.num_slots = num_slots
         self.max_len = max_len
-        self._pool = init_cache(dcfg, num_slots, max_len)
+        self._pool = init_cache(dcfg, num_slots, max_len, kv_dtype=kv_dtype)
         budget = {"draft": 1, "draft_prefill": n_prefill_programs}
         self._draft = jax.jit(
             registry.guard("draft", budget["draft"])(self._draft_fn),
@@ -170,7 +180,8 @@ class ModelDrafter:
                                          active)
         return drafts
 
-    def shardcheck_programs(self, mesh, *, buckets=(), rungs=()) -> list:
+    def shardcheck_programs(self, mesh, *, buckets=(), rungs=(),
+                            suffix: str = "") -> list:
         """ProgramSpecs for the drafter's compiled set (draft scan +
         the draft_prefill grid) under the engine's replicated-on-mesh
         contract — see Engine.shardcheck_programs. Requires build()."""
@@ -201,7 +212,7 @@ class ModelDrafter:
         args = (aparams, apool, sds((S,), jnp.int32), sds((S,), jnp.int32),
                 sds((S,), jnp.bool_))
         specs = [ProgramSpec(
-            name="drafter_draft",
+            name=f"drafter_draft{suffix}",
             lower=lambda: jit_rep(self._draft_fn).lower(*args),
             abstract_args=args, expect=expect, tags=("serve", "drafter"))]
         for bucket in buckets:
@@ -209,7 +220,7 @@ class ModelDrafter:
                 pargs = (aparams, apool, sds((k, bucket), jnp.int32),
                          sds((k,), jnp.int32))
                 specs.append(ProgramSpec(
-                    name=f"drafter_prefill_k{k}_L{bucket}",
+                    name=f"drafter_prefill{suffix}_k{k}_L{bucket}",
                     lower=(lambda pargs=pargs:
                            jit_rep(self._prefill_fn).lower(*pargs)),
                     abstract_args=pargs, expect=expect,

@@ -148,6 +148,20 @@ class Engine:
         rounds, per-request queued/generate). Default: a fresh bounded
         tracer; records only already-host-resident ints/floats, so it
         adds no host sync.
+    kv_dtype : KV-pool storage mode ('fp32' | 'bf16' | 'int8'; default
+        None = the model's compute dtype, the pre-int8 behavior).
+        'int8' stores per-(slot, head, position) scales alongside the
+        values (models/gpt.py init_cache): ~2x less HBM per cached
+        token than bf16 — 2x the slots at constant HBM — and
+        proportionally less decode read traffic. Applies to the
+        drafter's pool too (spec verify and drafts read the same mode).
+    decode_impl : cached-decode attention impl for the T=1 hot path
+        ('auto' | 'pallas' | 'pallas_interpret' | 'xla',
+        ops/flash_decode.py ladder). Default None keeps the model
+        config's own setting. The RESOLVED impl (auto settles on
+        pallas or xla at construction, with a warn_once when a TPU
+        lands on the fallback) is exported as the
+        serve_decode_attention_impl gauge and in stats().
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -155,13 +169,29 @@ class Engine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  pipeline: bool = True, spec=None,
                  metrics: Optional[MetricRegistry] = None,
-                 tracer: Optional[SpanTracer] = None):
+                 tracer: Optional[SpanTracer] = None,
+                 kv_dtype: Optional[str] = None,
+                 decode_impl: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
-        from nanosandbox_tpu.models.gpt import init_cache
+        from nanosandbox_tpu.models.gpt import init_cache, normalize_kv_dtype
+        from nanosandbox_tpu.ops.flash_decode import resolve_decode_impl
 
+        if decode_impl is not None and decode_impl != model.cfg.decode_impl:
+            # Rebind the module with the requested decode impl; params
+            # are impl-independent, so the same tree serves the rebuilt
+            # module (the same move sample.py relies on for dtype casts).
+            model = type(model)(
+                cfg=model.cfg.replace(decode_impl=decode_impl),
+                mesh=getattr(model, "mesh", None))
         cfg = model.cfg
+        self.kv_dtype = normalize_kv_dtype(kv_dtype) or (
+            "bf16" if cfg.compute_dtype == "bfloat16" else "fp32")
+        # Resolve ONCE at construction (the probe caches per backend):
+        # 'auto' degrading to xla on a TPU fires the warn_once here, at
+        # startup, not silently inside the first traced decode step.
+        self.decode_impl = resolve_decode_impl(cfg.decode_impl)
         self.model = model
         self.params = params
         self.cfg = cfg
@@ -179,7 +209,23 @@ class Engine:
         self.sched = SlotScheduler(num_slots, buckets)
         self.admit_buckets = self.sched.admit_buckets
 
-        self._pool = init_cache(cfg, num_slots, self.max_len)
+        if self.decode_impl != "xla":
+            from nanosandbox_tpu.ops.flash_decode import decode_pad_copies
+            from nanosandbox_tpu.utils.metrics import warn_once
+
+            if decode_pad_copies(self.max_len, cfg.n_embd // cfg.n_head):
+                # The kernel would jnp.pad — copy — the whole pool
+                # inside EVERY decode step, erasing the bytes the
+                # kernel/int8 exist to save. Loud beats silent.
+                warn_once(
+                    f"flash-decode-pad-copy-{self.max_len}",
+                    f"[serve] max_len={self.max_len} (head_dim "
+                    f"{cfg.n_embd // cfg.n_head}) forces the flash-decode "
+                    "kernel to pad-copy the KV pool on every step — use a "
+                    "multiple of 32 (and head_dim 64 or a 128-multiple) "
+                    "to keep the decode read zero-copy.")
+        self._pool = init_cache(cfg, num_slots, self.max_len,
+                                kv_dtype=kv_dtype)
         # Device-resident per-slot decode operands. Idle rows keep
         # harmless parked values (pos 0, temperature 0, active False):
         # their garbage decode writes stay inside their own slot row,
@@ -264,6 +310,17 @@ class Engine:
         self._g_rate = m.gauge(
             "serve_decode_tokens_per_sec",
             "Generated tokens/sec over the recent readback window.")
+        # The RESOLVED decode-attention impl and KV storage mode, as
+        # 1-hot labeled gauges: a scrape can tell whether this engine is
+        # on the flash kernel or silently landed on the xla fallback
+        # (the warn_once above fires once; the gauge persists).
+        self._g_impl = m.gauge(
+            "serve_decode_attention_impl",
+            "Resolved cached-decode attention impl (1 = active).",
+            labelnames=("impl",))
+        self._g_kv = m.gauge(
+            "serve_kv_dtype", "KV-pool storage mode (1 = active).",
+            labelnames=("kv_dtype",))
         m.add_collector(self._collect_metrics)
         self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
         # On-demand jax.profiler window (POST /profile): requested from
@@ -295,7 +352,8 @@ class Engine:
                 max_len=self.max_len,
                 n_prefill_programs=(len(self.sched.buckets)
                                     * len(self.admit_buckets)),
-                registry=self.tracecheck, on_accel=on_accel)
+                registry=self.tracecheck, on_accel=on_accel,
+                kv_dtype=kv_dtype, decode_impl=cfg.decode_impl)
         # Acceptance observability (windowed histograms, like the
         # latency signal): per-verify-row accepted lengths and
         # per-request accepted-token totals.
@@ -428,6 +486,8 @@ class Engine:
         self._g_queued.set(self.sched.queued)
         rate = self._recent_rate()
         self._g_rate.set(0.0 if rate is None else rate)
+        self._g_impl.labels(impl=self.decode_impl).set(1.0)
+        self._g_kv.labels(kv_dtype=self.kv_dtype).set(1.0)
         for name, n in self.tracecheck.counts().items():
             self._c_traces.labels(program=name)._set_total(n)
 
@@ -686,6 +746,8 @@ class Engine:
         return {
             "num_slots": self.num_slots,
             "max_len": self.max_len,
+            "kv_dtype": self.kv_dtype,
+            "decode_attention_impl": self.decode_impl,
             "prefill_buckets": list(self.sched.buckets),
             "admit_buckets": list(self.admit_buckets),
             "pipeline": self.pipeline,
@@ -761,8 +823,11 @@ class Engine:
         def sds(shape, dtype):
             return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
 
+        # int8-KV engines publish under distinct names so one budget
+        # file can pin BOTH pool modes' comms (the fleet commits both).
+        sfx = "_kv8" if self.kv_dtype == "int8" else ""
         specs = [ProgramSpec(
-            name="decode",
+            name=f"decode{sfx}",
             lower=lambda: jit_rep(self._decode_fn).lower(aparams, apool,
                                                          astate),
             abstract_args=(aparams, apool, astate),
@@ -774,14 +839,15 @@ class Engine:
                         sds((k,), jnp.float32), sds((k,), jnp.int32),
                         sds((k,), jnp.float32), sds((k,), jnp.int32))
                 specs.append(ProgramSpec(
-                    name=f"prefill_k{k}_L{bucket}",
+                    name=f"prefill{sfx}_k{k}_L{bucket}",
                     lower=(lambda args=args:
                            jit_rep(self._prefill_fn).lower(*args)),
                     abstract_args=args, expect=expect, tags=("serve",)))
         if self._spec is not None:
             specs.extend(self._spec.shardcheck_programs(
                 mesh, aparams=aparams, apool=apool, astate=astate,
-                buckets=self.sched.buckets, rungs=self.admit_buckets))
+                buckets=self.sched.buckets, rungs=self.admit_buckets,
+                suffix=sfx))
         return specs
 
     @property

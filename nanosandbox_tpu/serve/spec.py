@@ -64,7 +64,8 @@ class SpecRunner:
     decode step (donated on accelerators)."""
 
     def __init__(self, drafter, *, model, num_slots: int, max_len: int,
-                 n_prefill_programs: int, registry, on_accel: bool):
+                 n_prefill_programs: int, registry, on_accel: bool,
+                 kv_dtype=None, decode_impl=None):
         import jax
 
         self.drafter = drafter
@@ -77,10 +78,18 @@ class SpecRunner:
             raise ValueError("speculative decoding needs max_len >= 2")
         self.programs = {"verify": 1}
         if drafter.kind == "device":
+            # kv_dtype and decode_impl ride through to the drafter's OWN
+            # pool and model: the engine's verify reads the shared
+            # target pool (already in the engine's mode), and a drafter
+            # serving an int8 target should not quietly hold a
+            # full-precision cache — nor keep running a kernel the
+            # operator pinned AWAY from (--decode_impl=xla must reach
+            # the drafter's T=1 draft steps too).
             self.programs.update(drafter.build(
                 target_cfg=model.cfg, num_slots=num_slots, max_len=max_len,
                 n_prefill_programs=n_prefill_programs, registry=registry,
-                on_accel=on_accel))
+                on_accel=on_accel, kv_dtype=kv_dtype,
+                decode_impl=decode_impl))
         self._verify = jax.jit(
             registry.guard("verify", self.programs["verify"])(
                 self._verify_fn),
@@ -233,7 +242,7 @@ class SpecRunner:
 
     # ------------------------------------------------------------------
     def shardcheck_programs(self, mesh, *, aparams, apool, astate,
-                            buckets=(), rungs=()) -> list:
+                            buckets=(), rungs=(), suffix: str = "") -> list:
         """ProgramSpecs for the verify program (and, for a device
         drafter, its draft/draft_prefill programs) — the speculative
         half of Engine.shardcheck_programs, same replicated-on-the-mesh
@@ -252,14 +261,14 @@ class SpecRunner:
                                     sharding=rep)
         args = (aparams, apool, astate, drafts, dlen)
         specs = [ProgramSpec(
-            name="spec_verify",
+            name=f"spec_verify{suffix}",
             lower=lambda: jax.jit(self._verify_fn, in_shardings=rep,
                                   out_shardings=rep).lower(*args),
             abstract_args=args,
             expect=Expectations(comms_free=True), tags=("serve", "spec"))]
         if self.drafter.kind == "device":
             specs.extend(self.drafter.shardcheck_programs(
-                mesh, buckets=buckets, rungs=rungs))
+                mesh, buckets=buckets, rungs=rungs, suffix=suffix))
         return specs
 
     def stats(self) -> dict:

@@ -1,0 +1,410 @@
+"""Flash-decode kernel + int8 KV cache tests (ISSUE 8).
+
+The contract under test:
+  * the Pallas kernel (interpret mode on CPU — the exact math CI ships)
+    matches masked reference attention under FUZZED per-row frontiers,
+    fp and int8 alike;
+  * per-block int8 quantization round-trips within the analytic bound
+    (|err| <= max|row| / 254 per element);
+  * an int8-KV engine stays greedy-token-faithful to the fp engine on
+    mixed batches (bounded logit drift -> bounded token divergence),
+    with the SAME compile budget (the kernel must not widen the set);
+  * speculative-decode acceptance does not regress under int8 KV;
+  * the scalar-index (prefill) attention path is BOUNDED to the known
+    frontier — no dot in the jaxpr touches the full max_len buffer;
+  * the resolved decode impl + kv mode are exported (stats + /metrics
+    gauges) and the auto->xla degrade on TPU warns once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import (GPT, init_cache, normalize_kv_dtype,
+                                        scatter_cache_rows)
+from nanosandbox_tpu.ops import flash_decode as fd
+from nanosandbox_tpu.serve import Engine, NGramDrafter
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+# ------------------------------------------------------------------ kernel
+
+@pytest.mark.parametrize("B,H,L,D,block_k", [
+    (3, 2, 100, 16, 32),    # padded D, padded L, multi-block walk
+    (2, 2, 64, 64, 64),     # the verified-unpadded D=64, single block
+    (1, 3, 257, 32, 128),   # L one past a block boundary
+])
+def test_flash_decode_frontier_fuzz_fp(B, H, L, D, block_k):
+    """Random per-row frontiers vs reference attention — the per-row
+    mask is the kernel's core claim (never attend past a row's own
+    frontier, stale tail contributes nothing)."""
+    rng = np.random.default_rng(hash((B, H, L, D)) % 2**32)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    # Poison the tail of every row past its frontier with huge values:
+    # a masking bug becomes a gross error, not a rounding blip.
+    k = rng.normal(size=(B, H, L, D)).astype(np.float32)
+    v = rng.normal(size=(B, H, L, D)).astype(np.float32)
+    lengths = rng.integers(1, L + 1, size=B).astype(np.int32)
+    for b in range(B):
+        k[b, :, lengths[b]:, :] = 1e4
+        v[b, :, lengths[b]:, :] = -1e4
+    k, v, lengths = jnp.asarray(k), jnp.asarray(v), jnp.asarray(lengths)
+    ref = fd.xla_decode_attention(q, k, v, lengths)
+    out = fd.flash_decode(q, k, v, lengths, block_k=block_k, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_decode_int8_matches_xla_int8_exactly():
+    """Kernel fused-dequant (scales folded into scores/probs) vs the
+    XLA int8 reference: the two impls share one numeric contract, so
+    they agree to float rounding — NOT just to quantization tolerance."""
+    rng = np.random.default_rng(7)
+    B, H, L, D = 4, 2, 96, 16
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, L + 1, size=B), jnp.int32)
+    kq, ks = fd.quantize_kv_rows(k)
+    vq, vs = fd.quantize_kv_rows(v)
+    ref = fd.xla_decode_attention(q, kq, vq, lengths, k_scale=ks, v_scale=vs)
+    out = fd.flash_decode(q, kq, vq, lengths, k_scale=ks, v_scale=vs,
+                          block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+    # ...and both sit near the fp answer (quantization-bounded drift).
+    fp = fd.xla_decode_attention(q, k, v, lengths)
+    assert float(jnp.max(jnp.abs(out - fp))) < 0.05
+
+
+def test_flash_decode_fp32_pool_keeps_precision_under_bf16_query():
+    """A full-precision pool must not be silently truncated to the
+    query's dtype on the flash path: with a bf16 q and an fp32 pool the
+    kernel dots in fp32 (the wider type), matching the XLA reference to
+    accumulation-order rounding rather than bf16 rounding."""
+    rng = np.random.default_rng(13)
+    B, H, L, D = 2, 2, 64, 16
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+    lengths = jnp.asarray([17, 64], jnp.int32)
+    # f32 end-to-end oracle; the kernel's only rounding should be the
+    # final bf16 output write (~1.6e-3 here). A kernel that truncated
+    # the pool to bf16 before the dots measures ~5e-3 on this seed, so
+    # the 2.5e-3 bound discriminates the regression.
+    ref32 = fd.xla_decode_attention(q.astype(jnp.float32), k, v, lengths)
+    out = fd.flash_decode(q, k, v, lengths, block_k=32, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref32), atol=2.5e-3)
+
+
+def test_flash_decode_validates_scale_args():
+    q = jnp.zeros((1, 1, 16))
+    k = jnp.zeros((1, 1, 32, 16))
+    s = jnp.ones((1, 1, 32))
+    with pytest.raises(ValueError, match="together"):
+        fd.flash_decode(q, k, k, jnp.ones(1, jnp.int32), k_scale=s)
+    with pytest.raises(ValueError, match="non-int8"):
+        fd.flash_decode(q, k, k, jnp.ones(1, jnp.int32),
+                        k_scale=s, v_scale=s)
+    with pytest.raises(ValueError, match="unknown decode impl"):
+        fd.resolve_decode_impl("mosaic")
+
+
+# ------------------------------------------------------------ quantization
+
+def test_quantize_roundtrip_error_bound():
+    """Per-block (one scale per <=128-lane K/V row) symmetric int8:
+    every element round-trips within scale/2 = max|row|/254, the bound
+    the playbook's kv_dtype table quotes."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(3, 2, 40, 16)) * 5.0, jnp.float32)
+    q, s = fd.quantize_kv_rows(x)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    deq = q.astype(jnp.float32) * s[..., None]
+    bound = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 254.0
+    assert bool(jnp.all(jnp.abs(deq - x) <= bound + 1e-7))
+    # All-zero rows (parked slots, unwritten tail) are exact.
+    zq, zs = fd.quantize_kv_rows(jnp.zeros((2, 4)))
+    assert bool(jnp.all(zq == 0))
+
+
+def test_init_cache_kv_dtype_modes():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    compute_dtype="float32")
+    c8 = init_cache(cfg, 3, 16, kv_dtype="int8")
+    assert len(c8) == 2 and len(c8[0]) == 4
+    ck, cv, cks, cvs = c8[0]
+    assert ck.dtype == cv.dtype == jnp.int8
+    assert cks.shape == cvs.shape == (3, 2, 16) and cks.dtype == jnp.float32
+    cbf = init_cache(cfg, 3, 16, kv_dtype="bf16")
+    assert cbf[0][0].dtype == jnp.bfloat16 and len(cbf[0]) == 2
+    cfp = init_cache(cfg, 3, 16, kv_dtype="fp32")
+    assert cfp[0][0].dtype == jnp.float32
+    assert normalize_kv_dtype("bfloat16") == "bf16"
+    assert normalize_kv_dtype(None) is None
+    with pytest.raises(ValueError, match="kv_dtype"):
+        init_cache(cfg, 3, 16, kv_dtype="fp8")
+
+
+def test_scatter_cache_rows_quantizes_into_int8_pool():
+    """Prefill waves land already-quantized: fp rows scattered into an
+    int8 pool match direct quantization, ladder-padding rows drop, and
+    int8 rows into an fp pool refuse loudly."""
+    cfg = GPTConfig(n_layer=1, n_head=2, n_embd=32, block_size=64,
+                    compute_dtype="float32")
+    pool = init_cache(cfg, 4, 32, kv_dtype="int8")
+    rng = np.random.default_rng(3)
+    ck = jnp.asarray(rng.normal(size=(2, 2, 16, 16)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(2, 2, 16, 16)), jnp.float32)
+    slots = jnp.asarray([2, 4], jnp.int32)   # slot 4 is the drop row
+    out = scatter_cache_rows(pool, [(ck, cv)], slots)
+    pk, pv, pks, pvs = out[0]
+    kq, ks = fd.quantize_kv_rows(ck)
+    np.testing.assert_array_equal(np.asarray(pk[2, :, :16]),
+                                  np.asarray(kq[0]))
+    np.testing.assert_array_equal(np.asarray(pks[2, :, :16]),
+                                  np.asarray(ks[0]))
+    assert int(jnp.sum(jnp.abs(pk[3]))) == 0       # drop row untouched
+    with pytest.raises(ValueError, match="full-precision pool"):
+        scatter_cache_rows(init_cache(cfg, 4, 32),
+                           [(kq, kq, ks, ks)], slots)
+
+
+# ------------------------------------------------------------------ engine
+
+def _run_mixed(engine, n=12, seed=0, temperature=0.0):
+    rng = np.random.default_rng(seed)
+    rids = []
+    for _ in range(n):
+        L = int(rng.integers(1, 40))
+        rids.append(engine.submit(rng.integers(0, 50, L).tolist(),
+                                  int(rng.integers(2, 12)),
+                                  temperature=temperature, seed=7))
+    res = {r.rid: r for r in engine.drain()}
+    return [res[r].tokens for r in rids]
+
+
+def test_engine_greedy_parity_fp32_vs_int8_mixed_batch(served_model):
+    """The ISSUE-8 parity bar: int8 KV's logit drift is quantization-
+    bounded, so greedy tokens on a mixed continuous batch stay near-
+    identical to the fp engine — and the flash kernel (interpret) path
+    emits EXACTLY what the int8 xla path emits, since they share one
+    numeric contract."""
+    cfg, model, params = served_model
+    e_fp = Engine(model, params, num_slots=4, max_len=64)
+    e_8 = Engine(model, params, num_slots=4, max_len=64, kv_dtype="int8")
+    e_8k = Engine(model, params, num_slots=4, max_len=64, kv_dtype="int8",
+                  decode_impl="pallas_interpret")
+    a, b, c = _run_mixed(e_fp), _run_mixed(e_8), _run_mixed(e_8k)
+    total = sum(len(t) for t in a)
+    match_q = sum(sum(x == y for x, y in zip(p, q)) for p, q in zip(a, b))
+    assert match_q / total >= 0.95, (match_q, total)
+    assert b == c  # kernel vs xla int8: same tokens, not just close
+
+
+def test_engine_int8_budget_not_widened(served_model):
+    """The kernel must not widen the compile set: same max_programs()
+    dict as the fp engine, trace counts within it after a full mixed
+    drain, and the tracecheck postcondition holds."""
+    cfg, model, params = served_model
+    e_fp = Engine(model, params, num_slots=4, max_len=64)
+    e_8 = Engine(model, params, num_slots=4, max_len=64, kv_dtype="int8",
+                 decode_impl="pallas_interpret")
+    assert e_8.max_programs() == e_fp.max_programs()
+    _run_mixed(e_8)
+    e_8.tracecheck.assert_within_budget()
+    assert e_8.trace_counts["decode"] == 1
+
+
+def test_engine_sampled_path_runs_under_int8(served_model):
+    """Temperature > 0 rides the same per-row keyed streams; int8 only
+    perturbs logits, so the sampled path must run (and complete) with
+    the quantized pool + flash kernel."""
+    cfg, model, params = served_model
+    e = Engine(model, params, num_slots=4, max_len=64, kv_dtype="int8",
+               decode_impl="pallas_interpret")
+    toks = _run_mixed(e, n=6, seed=5, temperature=0.8)
+    assert all(len(t) >= 2 for t in toks)
+
+
+def test_spec_acceptance_non_regression_under_int8(served_model):
+    """Spec verify reads the same quantized pool; on the repetitive
+    workload (the drafter's favorable regime) acceptance under int8
+    must stay within a point of fp32 — the ISSUE-8 'within 1%' bar,
+    deterministic here (fixed seeds, greedy)."""
+    cfg, model, params = served_model
+
+    def run_rep(engine, n=10, seed=1):
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            motif = rng.integers(0, 50, 3)
+            L = int(rng.integers(6, 40))
+            engine.submit(np.tile(motif, L // 3 + 1)[:L].tolist(), 10)
+        engine.drain()
+        return engine.stats()["spec_acceptance_rate"]
+
+    acc_fp = run_rep(Engine(model, params, num_slots=4, max_len=64,
+                            spec=NGramDrafter(k=4)))
+    acc_8 = run_rep(Engine(model, params, num_slots=4, max_len=64,
+                           spec=NGramDrafter(k=4), kv_dtype="int8"))
+    assert acc_fp is not None and acc_fp > 0.5   # the regime is favorable
+    assert acc_8 >= acc_fp - 0.01, (acc_8, acc_fp)
+
+
+def test_spec_greedy_parity_under_int8(served_model):
+    """Verify and plain decode read one pool mode: spec-on int8 output
+    equals spec-off int8 output token-for-token under greedy decoding
+    (the Leviathan exactness argument is dtype-independent)."""
+    cfg, model, params = served_model
+    e_plain = Engine(model, params, num_slots=4, max_len=64,
+                     kv_dtype="int8")
+    e_spec = Engine(model, params, num_slots=4, max_len=64,
+                    kv_dtype="int8", spec=NGramDrafter(k=4))
+    assert _run_mixed(e_plain, n=8, seed=2) == _run_mixed(e_spec, n=8,
+                                                          seed=2)
+
+
+# ------------------------------------------------- bounded scalar prefill
+
+def test_scalar_prefill_attention_bounded_to_frontier(served_model):
+    """Satellite: with a STATIC cache_index the masked path slices the
+    buffer to the known frontier — pinned structurally (no dot_general
+    in the jaxpr touches the full max_len buffer) and numerically
+    (bit-identical logits to an exactly-sized cache)."""
+    cfg, model, params = served_model
+    T, max_len = 8, 64
+    prompt = jnp.asarray(np.random.default_rng(0).integers(0, 50, (2, T)),
+                         jnp.int32)
+
+    def prefill(params, prompt):
+        cache = init_cache(cfg, 2, max_len)
+        return model.apply({"params": params}, prompt, deterministic=True,
+                           cache=cache, cache_index=0)[0]
+
+    jaxpr = jax.make_jaxpr(prefill)(params, prompt)
+    dot_dims = {d for eqn in jaxpr.jaxpr.eqns
+                if eqn.primitive.name == "dot_general"
+                for v in eqn.outvars for d in v.aval.shape}
+    # Distinctive sentinel: nothing else in this config is 64-sized, so
+    # any 64 in a dot output means the attention read the whole buffer.
+    assert max_len not in dot_dims, sorted(dot_dims)
+    # FLOP pin: bounded span = T columns instead of max_len, i.e. the
+    # score dots shrank by max_len/T = 8x on this shape.
+    assert T in dot_dims
+
+    tight = init_cache(cfg, 2, T)
+    tight_logits = model.apply({"params": params}, prompt,
+                               deterministic=True, cache=tight,
+                               cache_index=0)[0]
+    np.testing.assert_array_equal(np.asarray(prefill(params, prompt)),
+                                  np.asarray(tight_logits))
+
+
+# -------------------------------------------------------- impl resolution
+
+def test_resolve_decode_impl_ladder(monkeypatch):
+    assert fd.resolve_decode_impl("xla") == "xla"
+    assert fd.resolve_decode_impl("pallas_interpret") == "pallas_interpret"
+    # CPU: auto degrades to xla silently (no TPU to warn about).
+    assert fd.resolve_decode_impl("auto") == "xla"
+    # TPU whose probe fails: the degrade must warn_once.
+    from nanosandbox_tpu.utils import metrics as um
+    um.reset_for_tests()
+    monkeypatch.setattr(fd, "_backend", lambda: "tpu")
+    monkeypatch.setattr(fd, "decode_compile_probe", lambda: False)
+    assert fd.resolve_decode_impl("auto") == "xla"
+    assert "flash-decode-xla-fallback" in um._WARNED_ONCE
+    um.reset_for_tests()
+
+
+def test_model_drafter_follows_engine_decode_impl(served_model):
+    """The engine's --decode_impl pin reaches the drafter's own model:
+    a drafter built under an engine pinned to the interpret kernel (or
+    away from a broken one) drafts through the same ladder rung."""
+    from nanosandbox_tpu.serve import ModelDrafter
+
+    cfg, model, params = served_model
+    dcfg = GPTConfig(n_layer=1, n_head=2, n_embd=32, block_size=64,
+                     vocab_size=50, dropout=0.0, compute_dtype="float32",
+                     attention_impl="xla")
+    dmodel = GPT(dcfg)
+    dparams = dmodel.init(jax.random.key(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    drafter = ModelDrafter(dmodel, dparams, k=3)
+    Engine(model, params, num_slots=2, max_len=32, prefill_buckets=(16, 32),
+           spec=drafter, kv_dtype="int8", decode_impl="pallas_interpret")
+    assert drafter.model.cfg.decode_impl == "pallas_interpret"
+
+
+def test_engine_warns_on_pad_copy_pool_shape(served_model):
+    """A pool shape the kernel must pad-copy every step (max_len off
+    the 32 quantum) warns at construction instead of silently doubling
+    the hot path's HBM traffic; 32-multiples stay quiet."""
+    from nanosandbox_tpu.utils import metrics as um
+
+    cfg, model, params = served_model
+    assert fd.decode_pad_copies(100, 16) and not fd.decode_pad_copies(64, 64)
+    um.reset_for_tests()
+    Engine(model, params, num_slots=2, max_len=60,
+           decode_impl="pallas_interpret")
+    assert "flash-decode-pad-copy-60" in um._WARNED_ONCE
+    # Zero-copy shape (32-multiple max_len AND head_dim 64): quiet.
+    cfg64 = GPTConfig(n_layer=1, n_head=1, n_embd=64, block_size=64,
+                      vocab_size=50, dropout=0.0, compute_dtype="float32",
+                      attention_impl="xla")
+    m64 = GPT(cfg64)
+    p64 = m64.init(jax.random.key(2), jnp.zeros((1, 8), jnp.int32))["params"]
+    um.reset_for_tests()
+    Engine(m64, p64, num_slots=2, max_len=64,
+           decode_impl="pallas_interpret")
+    assert not any(k.startswith("flash-decode-pad-copy")
+                   for k in um._WARNED_ONCE)
+    um.reset_for_tests()
+
+
+def test_engine_exports_impl_and_kv_mode(served_model):
+    cfg, model, params = served_model
+    e = Engine(model, params, num_slots=2, max_len=64, kv_dtype="int8",
+               decode_impl="pallas_interpret")
+    s = e.stats()
+    assert s["kv_dtype"] == "int8"
+    assert s["decode_attention_impl"] == "pallas_interpret"
+    snap = e.metrics.snapshot()
+    assert snap["serve_decode_attention_impl"]["series"][0]["labels"] == \
+        {"impl": "pallas_interpret"}
+    assert snap["serve_kv_dtype"]["series"][0]["labels"] == \
+        {"kv_dtype": "int8"}
+
+
+def test_bench_decode_int8_mode_emits_comparison():
+    """bench.py --mode=decode --kv_dtype=int8 runs the baseline twin in
+    the same interleaved rounds and records ratio + parity + bytes/token
+    (the ISSUE-8 acceptance numbers live in this JSON)."""
+    import bench
+
+    out = bench.main(["--quick", "--mode=decode", "--kv_dtype=int8",
+                      "--requests=4", "--max_new_tokens=4",
+                      "--num_slots=2"])
+    extra = out["extra"]
+    assert extra["kv_dtype"] == "int8"
+    assert extra["baseline_kv_dtype"] in ("fp32", "bf16")
+    assert extra["int8_vs_fp32"] == extra["kv_vs_baseline"] > 0
+    assert 0.9 <= extra["kv_greedy_parity"] <= 1.0
+    assert (extra["estimated_hbm_bytes_per_token"]
+            < extra["estimated_hbm_bytes_per_token_baseline"])
+    assert extra["decode_attention_impl"] == "xla"  # auto on CPU
+    assert extra["decode_impl_status"]["pallas_interpret"] == "ok"
