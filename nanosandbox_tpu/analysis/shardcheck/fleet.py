@@ -84,7 +84,16 @@ def serve_programs(mesh) -> List:
     int8-KV twin (kv_dtype='int8', flash-decode in interpret mode so
     the analyzed decode program contains this kernel's actual ops).
     The *_kv8 programs pin that quantize-on-write, fused-dequant decode
-    stays comms-free exactly like the fp pool."""
+    stays comms-free exactly like the fp pool.
+
+    As of ISSUE 9 the unsuffixed programs are the BLOCK-PAGED engine —
+    decode/prefill/spec_verify/drafter programs all paging reads and
+    writes through the (num_slots, max_blocks) block table — which is
+    the layout the committed budget pins (still zero collectives: the
+    table gather/scatter partitions trivially under replication, the
+    contract ROADMAP-1 TP serving must rewrite). A dense fp32 engine
+    (no spec) keeps the pre-paged layout pinned under *_dense names —
+    the bench comparison baseline stays budgeted too."""
     import jax
     import jax.numpy as jnp
 
@@ -112,8 +121,11 @@ def serve_programs(mesh) -> List:
                         prefill_buckets=(16, 32),
                         spec=ModelDrafter(dmodel, dparams, k=3),
                         kv_dtype="int8", decode_impl="pallas_interpret")
+    engine_dense = Engine(model, params, num_slots=4, max_len=32,
+                          prefill_buckets=(16, 32), paged=False)
     return (engine.shardcheck_programs(mesh)
-            + engine_kv8.shardcheck_programs(mesh))
+            + engine_kv8.shardcheck_programs(mesh)
+            + engine_dense.shardcheck_programs(mesh))
 
 
 def frontier_slice_programs(mesh, constrained: bool) -> List:

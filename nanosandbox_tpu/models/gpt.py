@@ -44,7 +44,8 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool,
-                 cache: Optional[tuple] = None, cache_index=None):
+                 cache: Optional[tuple] = None, cache_index=None,
+                 block_table=None):
         cfg = self.cfg
         B, T, C = x.shape
         assert C % cfg.n_head == 0
@@ -79,7 +80,8 @@ class CausalSelfAttention(nn.Module):
             from jax import lax
 
             from nanosandbox_tpu.ops.flash_decode import (
-                flash_decode, quantize_kv_rows, resolve_decode_impl)
+                flash_decode, flash_decode_paged, quantize_kv_rows,
+                resolve_decode_impl)
 
             # int8 KV mode (init_cache kv_dtype='int8'): the layer cache
             # is (K int8, V int8, k_scale f32, v_scale f32) with one
@@ -96,7 +98,53 @@ class CausalSelfAttention(nn.Module):
                 k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
             Tc = ck.shape[2]
             per_row = getattr(cache_index, "ndim", 0) == 1
-            if per_row:
+            if block_table is not None:
+                # Block-paged pool (init_paged_cache): the layer holds
+                # GLOBAL (num_blocks, H, page, D) blocks and block_table
+                # maps each row's i-th logical chunk to a pool block.
+                # Write: position p of row b lands in pool block
+                # table[b, p // page] at offset p % page — one flat
+                # scatter over the (B*T) written positions, with the
+                # engine's unallocated sentinel (>= num_blocks) dropped
+                # so a parked/overrun row can never corrupt a block it
+                # does not own. Read: the T=1 hot path pages the flash
+                # kernel through the table (flash_decode_paged, same
+                # fused int8 dequant); everything else gathers the
+                # row's chain into contiguous (B, H, max_len, D) rows
+                # and falls through to the shared masked-score path —
+                # bit-identical math, the gather is the byte cost the
+                # kernel exists to avoid.
+                if not per_row:
+                    raise ValueError(
+                        "a paged cache is per-row by construction: "
+                        "cache_index must be a (B,) frontier vector")
+                n_blk, _, page, _ = ck.shape
+                nb = block_table.shape[1]
+                qpos = cache_index[:, None] + jnp.arange(T)[None, :]
+                jblk = qpos // page
+                blk = jnp.take_along_axis(block_table,
+                                          jnp.minimum(jblk, nb - 1), axis=1)
+                blk = jnp.where(jblk < nb, blk, n_blk)       # drop overruns
+                bf, of = blk.reshape(-1), (qpos % page).reshape(-1)
+
+                def _scatter_vals(buf, x):
+                    vals = x.transpose(0, 2, 1, 3).reshape(
+                        B * T, cfg.n_head, head_dim)
+                    return buf.at[bf, :, of, :].set(vals, mode="drop")
+
+                ck = _scatter_vals(ck, k_w)
+                cv = _scatter_vals(cv, v_w)
+                if quantized:
+
+                    def _scatter_scale(buf, s):
+                        vals = s.transpose(0, 2, 1).reshape(B * T,
+                                                            cfg.n_head)
+                        return buf.at[bf, :, of].set(vals, mode="drop")
+
+                    cks = _scatter_scale(cks, ks_w)
+                    cvs = _scatter_scale(cvs, vs_w)
+                Tc = nb * page
+            elif per_row:
                 # Per-row frontiers (serve engine's slot pool): each batch
                 # row b writes its K/V at its OWN position cache_index[b]
                 # and attends up to it. vmap over the batch dim turns the
@@ -147,13 +195,23 @@ class CausalSelfAttention(nn.Module):
                 # Fused single-query flash decode: one pass over each
                 # row's K/V blocks up to its own frontier, int8 dequant
                 # folded into scores/probs so quantized K/V never
-                # materializes in fp (ops/flash_decode.py).
-                y = flash_decode(
-                    q[:, :, 0, :], ck, cv, cache_index + 1,
-                    k_scale=cks, v_scale=cvs,
-                    sm_scale=1.0 / head_dim ** 0.5,
-                    interpret=(decode_impl == "pallas_interpret"))[
-                        :, :, None, :]
+                # materializes in fp (ops/flash_decode.py). A paged pool
+                # routes the block-table variant: the same walk, with
+                # each chunk's address an indirection through the table.
+                if block_table is not None:
+                    y = flash_decode_paged(
+                        q[:, :, 0, :], ck, cv, block_table,
+                        cache_index + 1, k_scale=cks, v_scale=cvs,
+                        sm_scale=1.0 / head_dim ** 0.5,
+                        interpret=(decode_impl == "pallas_interpret"))[
+                            :, :, None, :]
+                else:
+                    y = flash_decode(
+                        q[:, :, 0, :], ck, cv, cache_index + 1,
+                        k_scale=cks, v_scale=cvs,
+                        sm_scale=1.0 / head_dim ** 0.5,
+                        interpret=(decode_impl == "pallas_interpret"))[
+                            :, :, None, :]
             else:
                 # Masked-score XLA path. When cache_index is a STATIC int
                 # (prefill / sample.generate's first pass) the attended
@@ -167,7 +225,23 @@ class CausalSelfAttention(nn.Module):
                 span = Tc
                 if isinstance(cache_index, int):
                     span = min(cache_index + T, Tc)
-                ck_a, cv_a = ck[:, :, :span], cv[:, :, :span]
+                if block_table is not None:
+                    # XLA fallback / T > 1 verify blocks over a paged
+                    # pool: gather each row's block chain into the
+                    # contiguous rows the shared masked path expects.
+                    # Same values at the same positions as a dense row
+                    # (garbage beyond the frontier is masked either
+                    # way), so the math below is bit-identical.
+                    gathered, = _gather_paged_layers(
+                        [(ck, cv, cks, cvs) if quantized else (ck, cv)],
+                        block_table)
+                    ck_a, cv_a = gathered[0], gathered[1]
+                    cks_a = gathered[2] if quantized else None
+                    cvs_a = gathered[3] if quantized else None
+                else:
+                    ck_a, cv_a = ck[:, :, :span], cv[:, :, :span]
+                    cks_a = cks[:, :, :span] if quantized else None
+                    cvs_a = cvs[:, :, :span] if quantized else None
                 # (B|1, 1, T, span): kpos <= qpos. The unwritten/stale
                 # buffer tail beyond each row's frontier is masked off,
                 # so garbage K/V from a previous slot occupant never
@@ -184,11 +258,11 @@ class CausalSelfAttention(nn.Module):
                     # tensors (scale is constant across the head_dim
                     # contraction) — the same dequant-by-folding contract
                     # as the flash kernel, so the two paths agree.
-                    scores = scores * cks[:, :, None, :span]
+                    scores = scores * cks_a[:, :, None, :]
                 scores = jnp.where(mask, scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
                 if quantized:
-                    probs_v = (probs * cvs[:, :, None, :span]).astype(q.dtype)
+                    probs_v = (probs * cvs_a[:, :, None, :]).astype(q.dtype)
                     y = jnp.einsum("bhts,bhsd->bhtd", probs_v,
                                    cv_a.astype(q.dtype))
                 else:
@@ -305,12 +379,14 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool,
-                 cache: Optional[tuple] = None, cache_index=None):
+                 cache: Optional[tuple] = None, cache_index=None,
+                 block_table=None):
         cfg = self.cfg
         attn = CausalSelfAttention(cfg, mesh=self.mesh, name="attn")
         a_in = _layer_norm(cfg, "ln_1")(x).astype(cfg.compute_dtype)
         if cache is not None:
-            y, new_cache = attn(a_in, deterministic, cache, cache_index)
+            y, new_cache = attn(a_in, deterministic, cache, cache_index,
+                                block_table)
             x = x + y
         else:
             x = x + attn(a_in, deterministic)
@@ -344,7 +420,8 @@ class GPT(nn.Module):
     @nn.compact
     def __call__(self, idx: jax.Array, *, deterministic: bool = True,
                  return_hidden: bool = False,
-                 cache: Optional[list] = None, cache_index=None):
+                 cache: Optional[list] = None, cache_index=None,
+                 block_table=None):
         """Returns logits (B, T, vocab) — or, with return_hidden=True, the
         final-layernorm hidden states (B, T, C) so the caller can fuse the
         LM head into a chunked loss (chunked_cross_entropy_loss) without
@@ -407,7 +484,7 @@ class GPT(nn.Module):
             new_cache = []
             for i in range(cfg.n_layer):
                 x, layer_cache = Block(cfg, mesh=self.mesh, name=f"h_{i}")(
-                    x, deterministic, cache[i], cache_index)
+                    x, deterministic, cache[i], cache_index, block_table)
                 new_cache.append(layer_cache)
             x = _layer_norm(cfg, "ln_f")(x)
             logits = wte.attend(x.astype(cfg.param_dtype))
@@ -552,6 +629,73 @@ def scatter_cache_rows(pool: list, rows: list, slots: jax.Array) -> list:
         pv = pv.at[slots, :, :L, :].set(cv.astype(pv.dtype), mode="drop")
         out.append((pk, pv))
     return out
+
+
+def init_paged_cache(cfg: GPTConfig, num_blocks: int, page: int,
+                     kv_dtype=None) -> list:
+    """Per-layer K/V BLOCK pools, shape (num_blocks, H, page, head_dim).
+
+    The paged twin of init_cache: instead of one (B, H, max_len, D) row
+    per slot, the pool is a global heap of fixed-size blocks of ``page``
+    positions each, and a (num_slots, max_blocks) block table (serve
+    engine slot state) maps each row's logical positions onto blocks —
+    allocate-on-demand memory, refcount-shared prefixes
+    (serve/paged.py). Same kv_dtype modes as init_cache; 'int8' layers
+    are 4-tuples with (num_blocks, H, page) f32 per-position scales."""
+    kvd = normalize_kv_dtype(kv_dtype)
+    head_dim = cfg.n_embd // cfg.n_head
+    shape = (num_blocks, cfg.n_head, page, head_dim)
+    if kvd == "int8":
+        sshape = (num_blocks, cfg.n_head, page)
+        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(cfg.n_layer)]
+    if kvd == "fp32":
+        dtype = jnp.float32
+    elif kvd == "bf16":
+        dtype = jnp.bfloat16
+    else:
+        dtype = jnp.dtype(cfg.compute_dtype)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.n_layer)]
+
+
+def _gather_paged_layers(pool: list, block_table: jax.Array) -> list:
+    """Gather each row's block chain into contiguous per-layer rows:
+    (num_blocks, H, page, D) pool + (B, nb) table -> (B, H, nb*page, D)
+    rows (scales likewise). Sentinel table entries clamp to a real
+    block — their positions sit beyond the row's frontier and every
+    consumer masks them. This is the XLA fallback's per-step byte cost
+    (a full row-copy) that flash_decode_paged's in-kernel indirection
+    exists to avoid."""
+    B, nb = block_table.shape
+    out = []
+    for layer in pool:
+        pk, pv = layer[0], layer[1]
+        _, H, page, D = pk.shape
+        L = nb * page
+
+        def _vals(p):
+            return p[block_table].transpose(0, 2, 1, 3, 4).reshape(
+                B, H, L, D)
+
+        if len(layer) == 4:
+            pks, pvs = layer[2], layer[3]
+
+            def _scales(s):
+                return s[block_table].transpose(0, 2, 1, 3).reshape(B, H, L)
+
+            out.append((_vals(pk), _vals(pv), _scales(pks), _scales(pvs)))
+        else:
+            out.append((_vals(pk), _vals(pv)))
+    return out
+
+
+def gather_paged_rows(pool: list, block_table: jax.Array) -> list:
+    """Public alias of the per-layer paged gather (tests use it to
+    build the contiguous reference view of a paged pool)."""
+    return _gather_paged_layers(pool, block_table)
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,

@@ -68,9 +68,9 @@ LANES = 128
 SUBLANE_QUANTUM = 32
 DEFAULT_BLOCK_K = 256
 
-__all__ = ["flash_decode", "xla_decode_attention", "resolve_decode_impl",
-           "decode_compile_probe", "compile_probe_check",
-           "quantize_kv_rows", "DECODE_IMPLS"]
+__all__ = ["flash_decode", "flash_decode_paged", "xla_decode_attention",
+           "resolve_decode_impl", "decode_compile_probe",
+           "compile_probe_check", "quantize_kv_rows", "DECODE_IMPLS"]
 
 DECODE_IMPLS = ("auto", "pallas", "pallas_interpret", "xla")
 
@@ -287,6 +287,173 @@ def flash_decode(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Paged variant: the block-table indirection (ROADMAP-2 / ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                         page: int, heads: int, sm_scale: float,
+                         num_kb: int, quantized: bool):
+    """One grid step == one (row, block-slot) pair of the flattened
+    (B*H, max_blocks) grid. The CHUNK ADDRESS is the indirection: the
+    BlockSpec index_map reads the scalar-prefetched block table, so the
+    DMA for grid step (r, i) fetches pool block table[r // H, i] — the
+    paged twin of flash_decode's contiguous pl.ds(i * block_k) walk.
+    The online-softmax carry lives in VMEM scratch across the
+    sequential block dim (dimension_semantics: the row dim is parallel,
+    the block dim arbitrary); blocks at or past the row's frontier are
+    skipped at the compute level via pl.when, and the frontier block
+    masks by position exactly like the contiguous kernel. int8 dequant
+    is the same fold: scales multiply the (1, page) score/probability
+    rows, never a dequantized K/V tile."""
+    r = pl.program_id(0)
+    i = pl.program_id(1)
+    length = len_ref[r // heads]
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(i * page < length)
+    def _block():
+        dot_dt = (q_ref.dtype if quantized
+                  else jnp.promote_types(q_ref.dtype, k_ref.dtype))
+        q = q_ref[0].astype(dot_dt)                      # (1, D)
+        k = k_ref[0, 0]                                  # (page, D)
+        s = lax.dot_general(q, k.astype(dot_dt), (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (1, page)
+        if quantized:
+            s = s * ks_ref[0, 0][None, :]
+        s = s * sm_scale
+        kpos = i * page + lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        s = jnp.where(kpos < length, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if quantized:
+            p = p * vs_ref[0, 0][None, :]
+        v = v_ref[0, 0]
+        acc_ref[...] = acc_ref[...] * alpha + lax.dot_general(
+            p.astype(dot_dt), v.astype(dot_dt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(i == num_kb - 1)
+    def _out():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def flash_decode_paged(q: jax.Array, k: jax.Array, v: jax.Array,
+                       block_table: jax.Array, lengths: jax.Array, *,
+                       k_scale=None, v_scale=None,
+                       sm_scale: float | None = None,
+                       interpret: bool = False) -> jax.Array:
+    """Single-query flash attention over a BLOCK-PAGED pool.
+
+    q (B, H, D); k/v (num_blocks, H, page, D) — the global block pool,
+    fp32/bf16 or int8 with (num_blocks, H, page) f32 scales;
+    block_table (B, max_blocks) int32 mapping each row's i-th logical
+    chunk to a pool block (entries >= num_blocks are the engine's
+    unallocated sentinel — clamped in the index_map, masked/skipped by
+    length); lengths (B,) valid positions per row. Returns (B, H, D).
+
+    Unlike flash_decode there is no pool-wide pad path for the block
+    dim: ``page`` IS the DMA chunk, so the pool must be built with a
+    legal page (fp32 tiles at 8 sublanes, bf16 16, int8 32 — int8 pools
+    on real TPUs want page >= 32; the engine's paged_pad_copies warning
+    covers this). head_dim follows the same verified rule as
+    flash_decode (64 or 128-multiples unpadded; anything else pads
+    q AND the pool — a per-call copy the engine warns about)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be supplied together")
+    if k_scale is not None and (k.dtype != jnp.int8 or v.dtype != jnp.int8):
+        raise ValueError(
+            f"scales supplied for non-int8 k/v ({k.dtype}/{v.dtype})")
+    quantized = k_scale is not None
+    N, H, page, D = k.shape
+    B = q.shape[0]
+    if q.shape != (B, H, D):
+        raise ValueError(f"q shape {q.shape} != {(B, H, D)}")
+    if block_table.ndim != 2 or block_table.shape[0] != B:
+        raise ValueError(
+            f"block_table shape {block_table.shape} != ({B}, max_blocks)")
+    nb = block_table.shape[1]
+    pad_D = 0 if (D == 64 or D % 128 == 0) else (-D) % 128
+    if pad_D:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, pad_D)])
+        pads = [(0, 0), (0, 0), (0, 0), (0, pad_D)]
+        k, v = jnp.pad(k, pads), jnp.pad(v, pads)
+    Dp = D + pad_D
+    qf = q.reshape(B * H, 1, Dp)
+    if k_scale is not None:
+        ksf = k_scale.astype(jnp.float32)
+        vsf = v_scale.astype(jnp.float32)
+    else:
+        # Fixed operand list across modes (flash_decode's idiom): a
+        # 1-block dummy the index_map pins to block 0.
+        ksf = vsf = jnp.ones((1, 1, page), jnp.float32)
+
+    def q_map(r, i, lens, tbl):
+        return (r, 0, 0)
+
+    def kv_map(r, i, lens, tbl):
+        # THE indirection: chunk i of row r DMAs pool block tbl[row, i].
+        # Sentinel entries (>= N, the engine's unallocated marker) clamp
+        # to a real block — their contents are never read (pl.when skips
+        # whole blocks past the frontier, the iota mask the rest).
+        return (jnp.minimum(tbl[r // H, i], N - 1), r % H, 0, 0)
+
+    def scale_map(r, i, lens, tbl):
+        if not quantized:
+            return (0, 0, 0)
+        return (jnp.minimum(tbl[r // H, i], N - 1), r % H, 0)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, heads=H, sm_scale=sm_scale,
+        num_kb=nb, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, Dp), q_map),
+                pl.BlockSpec((1, 1, page, Dp), kv_map),
+                pl.BlockSpec((1, 1, page, Dp), kv_map),
+                pl.BlockSpec((1, 1, page), scale_map),
+                pl.BlockSpec((1, 1, page), scale_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, Dp), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((1, Dp), jnp.float32),
+                pltpu.VMEM((1, 1), jnp.float32),
+                pltpu.VMEM((1, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, Dp), q.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), jnp.asarray(block_table, jnp.int32),
+      qf, k, v, ksf, vsf)
+    return out.reshape(B, H, Dp)[:, :, :D]
+
+
+def paged_pad_copies(page: int, head_dim: int) -> bool:
+    """True when flash_decode_paged must pad — copy — the POOL on every
+    call: head_dim outside the verified-unpadded set. (A page off the
+    int8 32-sublane quantum shows up as a compile-probe failure, not a
+    pad: the page is the DMA chunk and cannot be padded in place.)"""
+    return not (head_dim == 64 or head_dim % 128 == 0)
+
+
+# ---------------------------------------------------------------------------
 # Dispatch: probe + impl ladder
 # ---------------------------------------------------------------------------
 
@@ -298,9 +465,10 @@ def _backend() -> str:
 
 
 def compile_probe_check(*, interpret: bool = False) -> None:
-    """AOT lower+compile the kernel on tiny shapes in BOTH kv modes (fp
-    and int8-with-scales), raising on failure. The ONE probe harness —
-    decode_compile_probe (the 'auto' gate) and bench.py's
+    """AOT lower+compile the kernels on tiny shapes in BOTH kv modes (fp
+    and int8-with-scales) and BOTH pool layouts (contiguous slot rows
+    and the block-paged table), raising on failure. The ONE probe
+    harness — decode_compile_probe (the 'auto' gate) and bench.py's
     preflight_decode_impls both call it, so the shapes the ladder is
     judged on can never drift between the two."""
     dt = jnp.float32 if interpret else jnp.bfloat16
@@ -309,6 +477,11 @@ def compile_probe_check(*, interpret: bool = False) -> None:
     kv8 = jax.ShapeDtypeStruct((2, 2, 256, 64), jnp.int8)
     sc = jax.ShapeDtypeStruct((2, 2, 256), jnp.float32)
     ln = jax.ShapeDtypeStruct((2,), jnp.int32)
+    # Paged shapes: an 8-block pool at the int8-legal page (32 rows).
+    pkv = jax.ShapeDtypeStruct((8, 2, 32, 64), dt)
+    pkv8 = jax.ShapeDtypeStruct((8, 2, 32, 64), jnp.int8)
+    psc = jax.ShapeDtypeStruct((8, 2, 32), jnp.float32)
+    tbl = jax.ShapeDtypeStruct((2, 4), jnp.int32)
 
     def fp(q, k, v, n):
         return flash_decode(q, k, v, n, interpret=interpret)
@@ -317,8 +490,17 @@ def compile_probe_check(*, interpret: bool = False) -> None:
         return flash_decode(q, k, v, n, k_scale=ks, v_scale=vs,
                             interpret=interpret)
 
+    def pfp(q, k, v, t, n):
+        return flash_decode_paged(q, k, v, t, n, interpret=interpret)
+
+    def pq8(q, k, v, t, n, ks, vs):
+        return flash_decode_paged(q, k, v, t, n, k_scale=ks, v_scale=vs,
+                                  interpret=interpret)
+
     jax.jit(fp).lower(q, kv, kv, ln).compile()
     jax.jit(q8).lower(q, kv8, kv8, ln, sc, sc).compile()
+    jax.jit(pfp).lower(q, pkv, pkv, tbl, ln).compile()
+    jax.jit(pq8).lower(q, pkv8, pkv8, tbl, ln, psc, psc).compile()
 
 
 def decode_compile_probe() -> bool:

@@ -12,9 +12,13 @@ Pieces:
   scheduler.py — SlotScheduler: FIFO queue, free-slot pool, prefill
                  bucket ladder + admission-wave ladder (the fixed-shape
                  admission policy).
-  engine.py    — Engine: slot-based KV cache pool, batched wave prefill,
-                 pipelined per-row decode over device-resident slot
-                 state, submit()/step()/drain().
+  engine.py    — Engine: block-paged KV pool (dense per-slot rows as the
+                 comparison baseline), batched wave prefill, pipelined
+                 per-row decode over device-resident slot state,
+                 submit()/step()/drain().
+  paged.py     — BlockPool + RadixPrefixCache: host-side block-id
+                 allocator with refcounted radix prefix reuse and LRU
+                 eviction (the elastic-memory half of ROADMAP item 2).
   http.py      — EngineLoop (background stepping thread) + a stdlib
                  ThreadingHTTPServer frontend.
   drafters.py  — speculative draft proposers: NGramDrafter (host-side
@@ -30,9 +34,12 @@ Pieces:
 from nanosandbox_tpu.serve.drafters import (ModelDrafter, NGramDrafter,
                                             drafter_from_flag)
 from nanosandbox_tpu.serve.engine import Engine, Request, Result
+from nanosandbox_tpu.serve.paged import (Allocation, BlockPool,
+                                         RadixPrefixCache, blocks_for)
 from nanosandbox_tpu.serve.scheduler import (SlotScheduler, admit_ladder,
                                              default_buckets)
 
 __all__ = ["Engine", "Request", "Result", "SlotScheduler",
            "admit_ladder", "default_buckets", "NGramDrafter",
-           "ModelDrafter", "drafter_from_flag"]
+           "ModelDrafter", "drafter_from_flag", "BlockPool",
+           "RadixPrefixCache", "Allocation", "blocks_for"]

@@ -34,6 +34,23 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_pipeline", action="store_true",
                     help="synchronous decode loop (debugging baseline); "
                          "default keeps one decode step in flight")
+    ap.add_argument("--paged", default="on", choices=("on", "off"),
+                    help="block-paged KV pool + radix prefix cache "
+                         "(default on): admission reserves each "
+                         "request's actual block need instead of a "
+                         "worst-case max_len row, and prompts sharing "
+                         "a resident prefix skip its prefill chunks. "
+                         "'off' restores the dense per-slot rows")
+    ap.add_argument("--kv_page_size", type=int, default=16,
+                    help="positions per KV block (paged pool); int8 "
+                         "pools on real TPUs want >= 32 (sublane "
+                         "tiling quantum)")
+    ap.add_argument("--kv_pool_blocks", type=int, default=0,
+                    help="paged pool size in blocks; 0 = num_slots * "
+                         "max_len / page (byte-identical to the dense "
+                         "pool)")
+    ap.add_argument("--no_prefix_cache", action="store_true",
+                    help="disable radix prefix reuse (paged pool only)")
     ap.add_argument("--kv_dtype", default=None,
                     choices=("fp32", "bf16", "int8"),
                     help="KV-pool storage mode (default: the serving "
@@ -121,7 +138,11 @@ def main(argv: list[str] | None = None) -> None:
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
                     max_len=args.max_len or None,
                     pipeline=not args.no_pipeline, spec=drafter,
-                    kv_dtype=args.kv_dtype, decode_impl=args.decode_impl)
+                    kv_dtype=args.kv_dtype, decode_impl=args.decode_impl,
+                    paged=args.paged == "on",
+                    kv_page_size=args.kv_page_size,
+                    kv_pool_blocks=args.kv_pool_blocks or None,
+                    prefix_cache=not args.no_prefix_cache)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -158,6 +179,14 @@ def main(argv: list[str] | None = None) -> None:
             for _ in range(k):
                 engine.submit([0] * length, new_tokens)
             engine.drain()
+            # A warmup prompt's blocks must never serve a prefix hit to
+            # the NEXT warmup wave: a hit shrinks the suffix bucket, and
+            # the (k, bucket) program this wave exists to compile would
+            # silently not compile — a post-freeze outage on the first
+            # real prompt that maps there. Same-wave submissions are
+            # safe (admission happens before any donation), so flushing
+            # between drains closes the hole completely.
+            engine.reset_prefix_cache()
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
           f"prefill program(s) ({args.warmup}), "
           f"{engine.trace_counts['admit']} admit, "
@@ -185,8 +214,12 @@ def main(argv: list[str] | None = None) -> None:
     loop.start()
     server = make_server(args.host, args.port, loop, tok.encode,
                          lambda ids: tok.decode([int(t) for t in ids]))
+    pool_desc = (f"paged pool {engine.kv_pool_blocks} blocks x "
+                 f"{engine.kv_page_size} positions"
+                 + ("" if args.no_prefix_cache else " + prefix cache")
+                 if engine.paged else "dense per-slot rows")
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
-          f"{engine.max_len} ctx (kv_dtype={engine.kv_dtype}, "
+          f"{engine.max_len} ctx ({pool_desc}, kv_dtype={engine.kv_dtype}, "
           f"decode_impl={engine.decode_impl}); prefill buckets "
           f"{engine.sched.buckets}; listening on "
           f"{args.host}:{args.port} (POST /generate, GET /healthz "

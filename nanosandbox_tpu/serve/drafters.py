@@ -120,19 +120,29 @@ class ModelDrafter:
         self._prefill = None
         self.num_slots = None
         self.max_len = None
+        self.paged = False
 
     # -- engine-driven lifecycle ------------------------------------------
 
     def build(self, *, target_cfg, num_slots: int, max_len: int,
               n_prefill_programs: int, registry, on_accel: bool,
-              kv_dtype=None, decode_impl=None) -> dict:
+              kv_dtype=None, decode_impl=None, paged: bool = False,
+              kv_page_size: int = 0, kv_pool_blocks: int = 0) -> dict:
         """Allocate the drafter pool + compile draft/prefill under the
         engine's trace registry; returns the program budget entries to
         merge into Engine.max_programs(). kv_dtype mirrors the engine's
         pool mode onto the drafter's own pool ('int8' halves it too);
         decode_impl (the ENGINE's setting) overrides the drafter
         model's own ladder rung, so an operator pinning the engine off
-        a broken kernel pins the drafter's draft steps with it."""
+        a broken kernel pins the drafter's draft steps with it.
+
+        ``paged`` mirrors the engine's block-paged layout: the drafter
+        pool becomes a parallel (kv_pool_blocks, H, page, D) heap
+        indexed by the ENGINE's block table — block lifecycle (alloc,
+        prefix sharing, eviction) is decided once, by the engine's
+        BlockPool, and both pools follow the same ids, which is also
+        why a prefix-cache hit skips the DRAFTER's prefill chunks for
+        free (its blocks for those ids still hold that prefix's K/V)."""
         import jax
 
         if decode_impl is not None and decode_impl != self.model.cfg.decode_impl:
@@ -140,7 +150,7 @@ class ModelDrafter:
                 cfg=self.model.cfg.replace(decode_impl=decode_impl),
                 mesh=getattr(self.model, "mesh", None))
 
-        from nanosandbox_tpu.models.gpt import init_cache
+        from nanosandbox_tpu.models.gpt import init_cache, init_paged_cache
 
         dcfg = self.model.cfg
         if dcfg.vocab_size != target_cfg.vocab_size:
@@ -155,29 +165,47 @@ class ModelDrafter:
                 "the target can reach")
         self.num_slots = num_slots
         self.max_len = max_len
-        self._pool = init_cache(dcfg, num_slots, max_len, kv_dtype=kv_dtype)
+        self.paged = bool(paged)
+        if self.paged:
+            self._pool = init_paged_cache(dcfg, kv_pool_blocks,
+                                          kv_page_size, kv_dtype=kv_dtype)
+        else:
+            self._pool = init_cache(dcfg, num_slots, max_len,
+                                    kv_dtype=kv_dtype)
         budget = {"draft": 1, "draft_prefill": n_prefill_programs}
+        draft_body = self._draft_paged_fn if self.paged else self._draft_fn
+        prefill_body = (self._prefill_paged_fn if self.paged
+                        else self._prefill_fn)
         self._draft = jax.jit(
-            registry.guard("draft", budget["draft"])(self._draft_fn),
+            registry.guard("draft", budget["draft"])(draft_body),
             donate_argnums=(1,) if on_accel else ())
         self._prefill = jax.jit(
             registry.guard("draft_prefill",
-                           budget["draft_prefill"])(self._prefill_fn),
+                           budget["draft_prefill"])(prefill_body),
             donate_argnums=(1,) if on_accel else ())
         return budget
 
-    def prefill_wave(self, prompts, slots) -> None:
+    def prefill_wave(self, prompts, meta) -> None:
         """Ingest an admission wave's (k_wave, L_bucket) prompts into the
         drafter pool at the wave's slot rows — called by the engine right
         after its own wave prefill, with the SAME staged device arrays
-        (ladder-padding rows carry the out-of-range slot id and drop)."""
-        self._pool = self._prefill(self.params, self._pool, prompts, slots)
+        (the engine's packed ``meta`` layout; ladder-padding rows carry
+        the out-of-range slot id / sentinel table row and drop). Under
+        the paged engine ``prompts`` is the SUFFIX block, written
+        straight into the drafter pool through the shared block table —
+        a prefix-cache hit skips the drafter's prefill chunks too."""
+        self._pool = self._prefill(self.params, self._pool, prompts, meta)
 
-    def draft(self, tok, pos, active):
+    def draft(self, tok, pos, active, table=None):
         """(S, k) greedy draft tokens for every slot at the engine's
-        frontiers; rewrites the drafter cache rows pos..pos+k-1."""
-        self._pool, drafts = self._draft(self.params, self._pool, tok, pos,
-                                         active)
+        frontiers; rewrites the drafter cache rows pos..pos+k-1 (via the
+        engine's block table when paged)."""
+        if self.paged:
+            self._pool, drafts = self._draft(self.params, self._pool, tok,
+                                             pos, active, table)
+        else:
+            self._pool, drafts = self._draft(self.params, self._pool, tok,
+                                             pos, active)
         return drafts
 
     def shardcheck_programs(self, mesh, *, buckets=(), rungs=(),
@@ -209,30 +237,44 @@ class ModelDrafter:
             return jax.jit(fn, in_shardings=rep, out_shardings=rep)
 
         S = self.num_slots
-        args = (aparams, apool, sds((S,), jnp.int32), sds((S,), jnp.int32),
-                sds((S,), jnp.bool_))
+        nb = (-(-self.max_len // self._pool[0][0].shape[2])
+              if self.paged else 0)
+        if self.paged:
+            args = (aparams, apool, sds((S,), jnp.int32),
+                    sds((S,), jnp.int32), sds((S,), jnp.bool_),
+                    sds((S, nb), jnp.int32))
+            draft_body = self._draft_paged_fn
+        else:
+            args = (aparams, apool, sds((S,), jnp.int32),
+                    sds((S,), jnp.int32), sds((S,), jnp.bool_))
+            draft_body = self._draft_fn
         specs = [ProgramSpec(
             name=f"drafter_draft{suffix}",
-            lower=lambda: jit_rep(self._draft_fn).lower(*args),
+            lower=lambda: jit_rep(draft_body).lower(*args),
             abstract_args=args, expect=expect, tags=("serve", "drafter"))]
+        meta_w = (nb + 5) if self.paged else 4
         for bucket in buckets:
             for k in rungs:
+                prefill_body = (self._prefill_paged_fn if self.paged
+                                else self._prefill_fn)
                 pargs = (aparams, apool, sds((k, bucket), jnp.int32),
-                         sds((k,), jnp.int32))
+                         sds((k, meta_w), jnp.int32))
                 specs.append(ProgramSpec(
                     name=f"drafter_prefill{suffix}_k{k}_L{bucket}",
-                    lower=(lambda pargs=pargs:
-                           jit_rep(self._prefill_fn).lower(*pargs)),
+                    lower=(lambda pargs=pargs, prefill_body=prefill_body:
+                           jit_rep(prefill_body).lower(*pargs)),
                     abstract_args=pargs, expect=expect,
                     tags=("serve", "drafter")))
         return specs
 
     # -- compiled bodies ---------------------------------------------------
 
-    def _prefill_fn(self, dparams, dpool, prompts, slots):
+    def _prefill_fn(self, dparams, dpool, prompts, meta):
         """Same shape discipline as Engine._prefill_fn, minus sampling:
         the drafter only needs the prompt K/V in its pool (the first
-        generated token reaches it through the engine's tok state)."""
+        generated token reaches it through the engine's tok state).
+        ``meta`` is the engine's packed dense staging row ([slot |
+        true_len | top_k | seed]); only the slot column matters here."""
         from nanosandbox_tpu.models.gpt import init_cache, scatter_cache_rows
 
         kk, L = prompts.shape
@@ -240,7 +282,47 @@ class ModelDrafter:
         _, cache = self.model.apply({"params": dparams}, prompts,
                                     deterministic=True, cache=cache,
                                     cache_index=0)
-        return scatter_cache_rows(dpool, cache, slots)
+        return scatter_cache_rows(dpool, cache, meta[:, 0])
+
+    def _prefill_paged_fn(self, dparams, dpool, suffix, meta):
+        """Engine._prefill_paged_fn minus the sampling: forward the
+        SUFFIX at per-row cache_index = hit length, its K/V written
+        straight into the drafter pool through the shared block table
+        (the resident prefix's drafter K/V rides the same refcounted
+        blocks, so a hit skips the DRAFTER's prefill chunks too).
+        Shared hit blocks stay read-only in the drafter pool as well —
+        the write range starts at the block-aligned hit boundary. meta
+        is the engine's packed paged staging row ([table (nb) | slot |
+        true_len | top_k | seed | hit_len])."""
+        nb = -(-self.max_len // self._pool[0][0].shape[2])
+        _, dpool = self.model.apply({"params": dparams}, suffix,
+                                    deterministic=True, cache=dpool,
+                                    cache_index=meta[:, nb + 4],
+                                    block_table=meta[:, :nb])
+        return dpool
+
+    def _draft_paged_fn(self, dparams, dpool, tok, pos, active, table):
+        """The k+1-step draft scan over the block-paged drafter pool:
+        identical control flow to _draft_fn, with every cached read and
+        write paged through the engine's block table."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def step(carry, _):
+            tok, pos, pool = carry
+            logits, pool = self.model.apply({"params": dparams},
+                                            tok[:, None],
+                                            deterministic=True, cache=pool,
+                                            cache_index=pos,
+                                            block_table=table)
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, tok)
+            pos = pos + active.astype(jnp.int32)
+            return (nxt, pos, pool), nxt
+
+        (_, _, dpool), drafts = lax.scan(step, (tok, pos, dpool), None,
+                                         length=self.k + 1)
+        return dpool, drafts[:self.k].T  # (k+1, S) -> (S, k)
 
     def _draft_fn(self, dparams, dpool, tok, pos, active):
         """k+1 greedy single-token steps over all slots, proposing the

@@ -112,6 +112,7 @@ class _Active:
     first_token_t: float = 0.0   # wall clock of the prefill-token readback
     spec_accepted: int = 0       # draft tokens this request accepted
     span: int = 0                # open "generate" span id (obs tracer)
+    alloc: object = None         # paged.Allocation (block-paged engines)
 
 
 class Engine:
@@ -162,6 +163,33 @@ class Engine:
         pallas or xla at construction, with a warn_once when a TPU
         lands on the fallback) is exported as the
         serve_decode_attention_impl gauge and in stats().
+    paged : block-paged KV pool (default True, the ROADMAP-2 layout):
+        the pool is a global heap of kv_pool_blocks fixed-size blocks
+        of kv_page_size positions, a device-resident (num_slots,
+        max_blocks) block table maps each slot's positions onto blocks,
+        and admission reserves each request's ACTUAL need
+        (ceil((prompt + max_new) / page) blocks) instead of a dense
+        worst-case (max_len) row — elastic memory at constant pool
+        bytes, plus prefix reuse (below). False restores the dense
+        per-slot rows (the PR 8 layout), kept as the bench comparison
+        baseline. Same compile set either way: the block table is
+        DATA, not shape, so max_programs() is identical.
+    kv_page_size : positions per KV block (paged only; must divide
+        max_len). Small pages waste less memory on final-block
+        fragmentation and shorten shareable-prefix granularity; large
+        pages cut table overhead and DMA count. On real TPUs int8
+        pools want >= 32 (the sublane tiling quantum — the compile
+        probe rejects smaller and decode falls back to XLA).
+    kv_pool_blocks : pool size in blocks (paged only; default
+        num_slots * max_len / page — byte-identical to the dense
+        pool, so paged-vs-dense comparisons hold pool HBM constant
+        while capacity becomes elastic).
+    prefix_cache : radix/trie prefix reuse over finished requests'
+        prompt blocks (paged only, default True): a request whose
+        prompt prefix is resident skips those prefill chunks entirely
+        — admission prefills only the (bucketed) suffix — with
+        refcounted copy-on-write block sharing and LRU eviction of
+        refcount-zero blocks (serve/paged.py).
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -171,12 +199,18 @@ class Engine:
                  metrics: Optional[MetricRegistry] = None,
                  tracer: Optional[SpanTracer] = None,
                  kv_dtype: Optional[str] = None,
-                 decode_impl: Optional[str] = None):
+                 decode_impl: Optional[str] = None,
+                 paged: bool = True, kv_page_size: int = 16,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: bool = True):
         import jax
         import jax.numpy as jnp
 
-        from nanosandbox_tpu.models.gpt import init_cache, normalize_kv_dtype
+        from nanosandbox_tpu.models.gpt import (init_cache,
+                                                init_paged_cache,
+                                                normalize_kv_dtype)
         from nanosandbox_tpu.ops.flash_decode import resolve_decode_impl
+        from nanosandbox_tpu.serve.paged import BlockPool
 
         if decode_impl is not None and decode_impl != model.cfg.decode_impl:
             # Rebind the module with the requested decode impl; params
@@ -210,10 +244,14 @@ class Engine:
         self.admit_buckets = self.sched.admit_buckets
 
         if self.decode_impl != "xla":
-            from nanosandbox_tpu.ops.flash_decode import decode_pad_copies
+            from nanosandbox_tpu.ops.flash_decode import (decode_pad_copies,
+                                                          paged_pad_copies)
             from nanosandbox_tpu.utils.metrics import warn_once
 
-            if decode_pad_copies(self.max_len, cfg.n_embd // cfg.n_head):
+            pad = (paged_pad_copies(kv_page_size, cfg.n_embd // cfg.n_head)
+                   if paged else
+                   decode_pad_copies(self.max_len, cfg.n_embd // cfg.n_head))
+            if pad:
                 # The kernel would jnp.pad — copy — the whole pool
                 # inside EVERY decode step, erasing the bytes the
                 # kernel/int8 exist to save. Loud beats silent.
@@ -224,12 +262,33 @@ class Engine:
                     "kernel to pad-copy the KV pool on every step — use a "
                     "multiple of 32 (and head_dim 64 or a 128-multiple) "
                     "to keep the decode read zero-copy.")
-        self._pool = init_cache(cfg, num_slots, self.max_len,
-                                kv_dtype=kv_dtype)
+        self.paged = bool(paged)
+        self.kv_page_size = int(kv_page_size) if self.paged else 0
+        self.block_pool = None
+        if self.paged:
+            if kv_page_size < 1:
+                raise ValueError(
+                    f"kv_page_size must be >= 1, got {kv_page_size}")
+            # ceil: a max_len off the page quantum just leaves the last
+            # block of a full-length request partially used.
+            self.slot_blocks = -(-self.max_len // kv_page_size)
+            self.kv_pool_blocks = int(kv_pool_blocks
+                                      or num_slots * self.slot_blocks)
+            self._pool = init_paged_cache(cfg, self.kv_pool_blocks,
+                                          kv_page_size, kv_dtype=kv_dtype)
+            self.block_pool = BlockPool(self.kv_pool_blocks, kv_page_size,
+                                        prefix_cache=prefix_cache)
+        else:
+            self.slot_blocks = 0
+            self.kv_pool_blocks = 0
+            self._pool = init_cache(cfg, num_slots, self.max_len,
+                                    kv_dtype=kv_dtype)
         # Device-resident per-slot decode operands. Idle rows keep
         # harmless parked values (pos 0, temperature 0, active False):
-        # their garbage decode writes stay inside their own slot row,
-        # which the next prefill overwrites.
+        # their garbage decode writes stay inside their own slot row —
+        # paged engines park the block-table row on the out-of-range
+        # sentinel (kv_pool_blocks) instead, so an idle row's garbage
+        # writes DROP rather than touch a block it no longer owns.
         self._state = {
             "pos": jnp.zeros(num_slots, jnp.int32),
             "tok": jnp.zeros(num_slots, jnp.int32),
@@ -239,6 +298,10 @@ class Engine:
             "seed": jnp.zeros(num_slots, jnp.int32),
             "active": jnp.zeros(num_slots, jnp.bool_),
         }
+        if self.paged:
+            self._state["table"] = jnp.full(
+                (num_slots, self.slot_blocks), self.kv_pool_blocks,
+                jnp.int32)
 
         self._active: Dict[int, _Active] = {}        # slot -> state
         self._pending_results: List[Result] = []     # max_new_tokens == 0
@@ -321,6 +384,30 @@ class Engine:
         self._g_kv = m.gauge(
             "serve_kv_dtype", "KV-pool storage mode (1 = active).",
             labelnames=("kv_dtype",))
+        # Paged-pool + prefix-cache signal (ISSUE 9): block states
+        # partition the pool, the hit/miss token counters are the
+        # prefix_hit_rate numerator/denominator, and TTFT re-observes
+        # into a by-prefix-outcome labeled histogram so the hit-vs-miss
+        # latency cut is a first-class /metrics series, not a bench-only
+        # artifact. All mirrored/observed host-side — zero hot-loop cost.
+        self._g_pool_blocks = m.gauge(
+            "serve_kv_pool_blocks",
+            "Paged KV pool blocks by state (free | live | cached).",
+            labelnames=("state",))
+        self._c_prefix_hit = m.counter(
+            "serve_prefix_hit_tokens_total",
+            "Prompt tokens skipped via radix prefix-cache hits.")
+        self._c_prefix_miss = m.counter(
+            "serve_prefix_miss_tokens_total",
+            "Prompt tokens prefilled from scratch.")
+        self._c_block_stalls = m.counter(
+            "serve_admission_block_stall_steps_total",
+            "Admission attempts deferred on KV-block availability "
+            "(the no-deadlock backpressure: the request stays queued).")
+        self._ttft_prefix = m.histogram(
+            "serve_prefix_ttft_seconds",
+            "Submit -> first-token seconds by prefix-cache outcome.",
+            unit="seconds", labelnames=("prefix",))
         m.add_collector(self._collect_metrics)
         self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
         # On-demand jax.profiler window (POST /profile): requested from
@@ -353,7 +440,9 @@ class Engine:
                 n_prefill_programs=(len(self.sched.buckets)
                                     * len(self.admit_buckets)),
                 registry=self.tracecheck, on_accel=on_accel,
-                kv_dtype=kv_dtype, decode_impl=cfg.decode_impl)
+                kv_dtype=kv_dtype, decode_impl=cfg.decode_impl,
+                paged=self.paged, kv_page_size=kv_page_size,
+                kv_pool_blocks=self.kv_pool_blocks)
         # Acceptance observability (windowed histograms, like the
         # latency signal): per-verify-row accepted lengths and
         # per-request accepted-token totals.
@@ -371,8 +460,15 @@ class Engine:
 
         budget = self.max_programs()
         guard = self.tracecheck.guard
+        # One prefill body per pool layout, published under ONE program
+        # name and budget: the paged variant swaps the temp-cache
+        # scatter for gather-prefix / suffix-forward / scatter-back, but
+        # its shape key is the same (rung, bucket) grid — the bucketed
+        # SUFFIX length, which without prefix hits IS the prompt bucket.
+        prefill_body = (self._prefill_paged_fn if self.paged
+                        else self._prefill_fn)
         self._prefill = jax.jit(
-            guard("prefill", budget["prefill"])(self._prefill_fn),
+            guard("prefill", budget["prefill"])(prefill_body),
             donate_argnums=(1,) if on_accel else ())
         self._decode = jax.jit(
             guard("decode", budget["decode"])(self._decode_fn),
@@ -387,8 +483,34 @@ class Engine:
     # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
-    def _prefill_fn(self, params, pool, prompts, true_lens, slots,
-                    temps, top_ks, top_ps, seeds):
+    # Wave-staging layout: the host packs a wave's per-row operands into
+    # THREE uploads instead of nine — on the dispatch-bound CPU serving
+    # floor each host->device staging array costs ~180us, so the packing
+    # is a measurable slice of every admission wave (and it keeps the
+    # paged and dense upload counts identical, which the paged-vs-dense
+    # bench comparison relies on):
+    #   prompts (k, L_bucket) int32 — the (suffix-)token block;
+    #   meta    (k, meta_width) int32 — paged: [table row (slot_blocks)
+    #           | slot | true_len | top_k | seed | hit_len]; dense:
+    #           [slot | true_len | top_k | seed];
+    #   fmeta   (k, 2) float32 — [temperature, top_p].
+    # meta_width is a per-RUNG constant, so the admit program (which
+    # consumes meta/fmeta plus the device-resident first tokens) keeps
+    # its one-program-per-rung budget.
+    @property
+    def _meta_width(self) -> int:
+        return (self.slot_blocks + 5) if self.paged else 4
+
+    def _split_meta(self, meta, fmeta):
+        nb = self.slot_blocks if self.paged else 0
+        tables = meta[:, :nb] if self.paged else None
+        slots, true_lens, top_ks, seeds = (meta[:, nb], meta[:, nb + 1],
+                                           meta[:, nb + 2], meta[:, nb + 3])
+        hits = meta[:, nb + 4] if self.paged else None
+        return tables, slots, hits, true_lens, top_ks, seeds, \
+            fmeta[:, 0], fmeta[:, 1]
+
+    def _prefill_fn(self, params, pool, prompts, meta, fmeta):
         """Admission wave (k, L_bucket) -> (new pool, first tokens (k,)).
 
         Runs the ordinary scalar-cache prefill on a batch-k temp cache of
@@ -403,6 +525,8 @@ class Engine:
         from nanosandbox_tpu.models.gpt import init_cache, scatter_cache_rows
         from nanosandbox_tpu.sample import _sample_token, row_keys
 
+        _, slots, _, true_lens, top_ks, seeds, temps, top_ps = \
+            self._split_meta(meta, fmeta)
         k, L = prompts.shape
         cache = init_cache(self.cfg, k, L)
         logits, cache = self.model.apply({"params": params}, prompts,
@@ -417,6 +541,44 @@ class Engine:
                                 top_k=top_ks, top_p=top_ps)
         return new_pool, toks
 
+    def _prefill_paged_fn(self, params, pool, suffix, meta, fmeta):
+        """Paged admission wave: (k, L_suffix_bucket) SUFFIX tokens ->
+        (new pool, first tokens (k,)).
+
+        ONE model call straight against the pool — no temp cache, no
+        scatter-back: the model's paged write path lands each row's
+        suffix K/V at positions [hit, hit + Ls) through its block-table
+        row (the same per-row vector-index scatter the spec verify
+        uses), and its paged read path gathers the row's chain — the
+        resident prefix INCLUDED — for the suffix's attention. The hit
+        skips the prefix's forward FLOPs, which is where TTFT goes;
+        shared hit blocks are never written (the write range starts at
+        the block-aligned hit boundary, always a private block) and
+        ladder-padding rows carry all-sentinel tables, so every one of
+        their writes drops.
+
+        The first token samples from position true_len - 1 with the
+        SAME fold_in(seed, true_len) key a from-scratch prefill would
+        use — prefix-hit outputs are token-identical to cold ones by
+        construction (pinned by test)."""
+        import jax.numpy as jnp
+
+        from nanosandbox_tpu.sample import _sample_token, row_keys
+
+        tables, _, hit_lens, true_lens, top_ks, seeds, temps, top_ps = \
+            self._split_meta(meta, fmeta)
+        k, _ = suffix.shape
+        logits, pool = self.model.apply({"params": params}, suffix,
+                                        deterministic=True, cache=pool,
+                                        cache_index=hit_lens,
+                                        block_table=tables)
+        suf_lens = true_lens - hit_lens
+        last = logits[jnp.arange(k), suf_lens - 1, :]
+        keys = row_keys(seeds, true_lens)
+        toks, _ = _sample_token(last, keys, temperature=temps,
+                                top_k=top_ks, top_p=top_ps)
+        return pool, toks
+
     def _decode_fn(self, params, pool, state):
         """One batched token step over ALL slots at per-row frontiers.
 
@@ -424,7 +586,9 @@ class Engine:
         becomes the next step's input ON DEVICE, so the host can dispatch
         step k+1 without ever reading step k back. Inactive rows are
         parked by the mask — frozen pos, pinned token — so a released
-        slot's garbage can't random-walk its own state."""
+        slot's garbage can't random-walk its own state. Paged pools ride
+        the same program: the block table is one more state leaf, and
+        the model's cached path pages reads/writes through it."""
         import jax.numpy as jnp
 
         from nanosandbox_tpu.sample import _sample_token, row_keys
@@ -432,7 +596,8 @@ class Engine:
         logits, pool = self.model.apply({"params": params},
                                         state["tok"][:, None],
                                         deterministic=True, cache=pool,
-                                        cache_index=state["pos"])
+                                        cache_index=state["pos"],
+                                        block_table=state.get("table"))
         keys = row_keys(state["seed"], state["pos"] + 1)
         nxt, _ = _sample_token(logits[:, 0, :], keys,
                                temperature=state["temp"],
@@ -443,13 +608,18 @@ class Engine:
                          tok=jnp.where(active, nxt, state["tok"]))
         return pool, new_state, nxt
 
-    def _admit_fn(self, state, slots, pos0, toks, temps, top_ks, top_ps,
-                  seeds):
+    def _admit_fn(self, state, toks, meta, fmeta):
         """Scatter an admission wave's operands into the slot-state rows.
 
-        One (k,)-shaped program per admit-ladder rung; padding rows carry
-        the out-of-range slot id num_slots, dropped by the scatter."""
-        return {
+        One per-rung program keyed by the packed (k, meta_width) staging
+        shape; padding rows carry the out-of-range slot id num_slots,
+        dropped by the scatter. Paged engines additionally scatter the
+        wave's (k, max_blocks) block-table rows. ``toks`` is the prefill
+        program's device-resident output — first tokens flow device-to-
+        device into the slot state, never through the host."""
+        tables, slots, _, pos0, top_ks, seeds, temps, top_ps = \
+            self._split_meta(meta, fmeta)
+        out = {
             "pos": state["pos"].at[slots].set(pos0, mode="drop"),
             "tok": state["tok"].at[slots].set(toks, mode="drop"),
             "temp": state["temp"].at[slots].set(temps, mode="drop"),
@@ -458,10 +628,17 @@ class Engine:
             "seed": state["seed"].at[slots].set(seeds, mode="drop"),
             "active": state["active"].at[slots].set(True, mode="drop"),
         }
+        if tables is not None:
+            out["table"] = state["table"].at[slots].set(tables, mode="drop")
+        return out
 
     def _release_fn(self, state, slot):
-        """Park one slot row back at the harmless idle values."""
-        return {
+        """Park one slot row back at the harmless idle values — for a
+        paged engine that includes pointing the whole block-table row at
+        the unallocated sentinel, so the parked row's garbage decode
+        writes DROP instead of landing in a block the host may have
+        already freed or donated to the prefix cache."""
+        out = {
             "pos": state["pos"].at[slot].set(0),
             "tok": state["tok"].at[slot].set(0),
             "temp": state["temp"].at[slot].set(0.0),
@@ -470,6 +647,9 @@ class Engine:
             "seed": state["seed"].at[slot].set(0),
             "active": state["active"].at[slot].set(False),
         }
+        if "table" in state:
+            out["table"] = state["table"].at[slot].set(self.kv_pool_blocks)
+        return out
 
     # ------------------------------------------------------------------
     # public API
@@ -488,6 +668,13 @@ class Engine:
         self._g_rate.set(0.0 if rate is None else rate)
         self._g_impl.labels(impl=self.decode_impl).set(1.0)
         self._g_kv.labels(kv_dtype=self.kv_dtype).set(1.0)
+        if self.block_pool is not None:
+            ps = self.block_pool.stats()
+            for state in ("free", "live", "cached"):
+                self._g_pool_blocks.labels(state=state).set(ps[state])
+            self._c_prefix_hit._set_total(ps["prefix_hit_tokens"])
+            self._c_prefix_miss._set_total(ps["prefix_miss_tokens"])
+            self._c_block_stalls._set_total(ps["block_stall_steps"])
         for name, n in self.tracecheck.counts().items():
             self._c_traces.labels(program=name)._set_total(n)
 
@@ -514,6 +701,19 @@ class Engine:
                 f"({max_new_tokens}) = {total} exceeds the per-slot KV "
                 f"length {self.max_len}; long-context decode belongs to "
                 "sample.py's windowed path")
+        if self.paged:
+            # The no-deadlock split: a request the POOL could never hold
+            # (even with every block free) is rejected HERE, loudly; one
+            # that merely cannot fit RIGHT NOW queues and admission
+            # defers it until running requests release blocks — full
+            # reservation at admit means nothing mid-decode ever waits.
+            need = self.block_pool.blocks_needed(len(prompt),
+                                                 max_new_tokens)
+            if need > self.kv_pool_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self.kv_pool_blocks}; raise kv_pool_blocks or "
+                    "shorten the request")
         rid = next(self._rid)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=float(temperature), top_k=int(top_k),
@@ -743,10 +943,21 @@ class Engine:
     def stats(self) -> dict:
         spec_stats = ({"enabled": False} if self._spec is None
                       else self._spec.stats())
+        paged_stats: dict = {"enabled": self.paged}
+        if self.block_pool is not None:
+            paged_stats.update(self.block_pool.stats())
+            paged_stats["ttft_hit_s"] = self._ttft_prefix.labels(
+                prefix="hit").percentiles((50, 90, 99))
+            paged_stats["ttft_miss_s"] = self._ttft_prefix.labels(
+                prefix="miss").percentiles((50, 90, 99))
         return {
             "num_slots": self.num_slots,
             "max_len": self.max_len,
             "kv_dtype": self.kv_dtype,
+            "paged": self.paged,
+            "kv_page_size": self.kv_page_size,
+            "kv_pool_blocks": self.kv_pool_blocks,
+            "kv_pool": paged_stats,
             "decode_attention_impl": self.decode_impl,
             "prefill_buckets": list(self.sched.buckets),
             "admit_buckets": list(self.admit_buckets),
@@ -824,24 +1035,30 @@ class Engine:
             return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
 
         # int8-KV engines publish under distinct names so one budget
-        # file can pin BOTH pool modes' comms (the fleet commits both).
+        # file can pin BOTH pool modes' comms (the fleet commits both);
+        # likewise the dense (pre-paged) layout keeps a _dense suffix —
+        # the unsuffixed names ARE the paged programs now, the default
+        # engine contract the budgets pin.
         sfx = "_kv8" if self.kv_dtype == "int8" else ""
+        if not self.paged:
+            sfx += "_dense"
         specs = [ProgramSpec(
             name=f"decode{sfx}",
             lower=lambda: jit_rep(self._decode_fn).lower(aparams, apool,
                                                          astate),
             abstract_args=(aparams, apool, astate),
             expect=expect, tags=("serve",))]
+        prefill_body = (self._prefill_paged_fn if self.paged
+                        else self._prefill_fn)
         for bucket in self.sched.buckets:
             for k in self.admit_buckets:
                 args = (aparams, apool, sds((k, bucket), jnp.int32),
-                        sds((k,), jnp.int32), sds((k,), jnp.int32),
-                        sds((k,), jnp.float32), sds((k,), jnp.int32),
-                        sds((k,), jnp.float32), sds((k,), jnp.int32))
+                        sds((k, self._meta_width), jnp.int32),
+                        sds((k, 2), jnp.float32))
                 specs.append(ProgramSpec(
                     name=f"prefill{sfx}_k{k}_L{bucket}",
                     lower=(lambda args=args:
-                           jit_rep(self._prefill_fn).lower(*args)),
+                           jit_rep(prefill_body).lower(*args)),
                     abstract_args=args, expect=expect, tags=("serve",)))
         if self._spec is not None:
             specs.extend(self._spec.shardcheck_programs(
@@ -860,10 +1077,36 @@ class Engine:
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
+    def _suffix_bucket(self, req) -> int:
+        """The paged wave key: the prefill bucket of the prompt MINUS
+        its resident prefix (a pure probe — blocks commit in the admit
+        callback). Requests sharing a hot system prompt therefore land
+        together in small-suffix waves; with a cold cache this is
+        exactly bucket_for(len(prompt))."""
+        hit = self.block_pool.match_len(req.prompt)
+        return self.sched.bucket_for(len(req.prompt) - hit)
+
     def _admit_waves(self, finished: List[Result]) -> None:
         import jax.numpy as jnp
 
-        while (wave := self.sched.next_admission_wave()) is not None:
+        while True:
+            allocs: List = []
+            if self.paged:
+
+                def try_alloc(req):
+                    a = self.block_pool.admit(req.prompt,
+                                              req.max_new_tokens)
+                    if a is None:
+                        return False
+                    allocs.append(a)
+                    return True
+
+                wave = self.sched.next_admission_wave(
+                    bucket_of=self._suffix_bucket, admit=try_alloc)
+            else:
+                wave = self.sched.next_admission_wave()
+            if wave is None:
+                break
             reqs, slots, bucket = wave
             k = self.sched.rung_for(len(reqs))
             self._c_waves.inc()
@@ -872,43 +1115,52 @@ class Engine:
                 args={"bucket": bucket, "rung": k, "wave": len(reqs),
                       "rids": [r.rid for r in reqs]})
             # Host staging for the wave — the ONLY host->device uploads
-            # the engine performs; the per-token loop stages nothing.
+            # the engine performs (three arrays, the packed layout above
+            # _meta_width); the per-token loop stages nothing.
+            nb = self.slot_blocks if self.paged else 0
             prompts = np.zeros((k, bucket), np.int32)
-            true_lens = np.ones(k, np.int32)
-            # Padding rows point at slot id num_slots: out of range, so
-            # both the pool scatter and the state scatter drop them.
-            slots_arr = np.full(k, self.num_slots, np.int32)
-            temps = np.zeros(k, np.float32)
-            top_ks = np.zeros(k, np.int32)
-            top_ps = np.ones(k, np.float32)
-            seeds = np.zeros(k, np.int32)
+            meta = np.zeros((k, self._meta_width), np.int32)
+            # Padding rows point at slot id num_slots (and, paged, an
+            # all-sentinel table row): out of range, so the pool writes
+            # and the state scatter all drop them.
+            meta[:, nb] = self.num_slots
+            meta[:, nb + 1] = 1                     # true_len floor
+            if self.paged:
+                meta[:, :nb] = self.kv_pool_blocks
+            fmeta = np.zeros((k, 2), np.float32)
+            fmeta[:, 1] = 1.0                       # top_p
             for i, (req, slot) in enumerate(zip(reqs, slots)):
-                prompts[i, :len(req.prompt)] = req.prompt
-                true_lens[i] = len(req.prompt)
-                slots_arr[i] = slot
-                temps[i] = req.temperature
-                top_ks[i] = req.top_k
-                top_ps[i] = req.top_p
-                seeds[i] = req.seed
-            true_lens = jnp.asarray(true_lens)
-            slots_dev = jnp.asarray(slots_arr)
-            temps = jnp.asarray(temps)
-            top_ks = jnp.asarray(top_ks)
-            top_ps = jnp.asarray(top_ps)
-            seeds = jnp.asarray(seeds)
+                meta[i, nb] = slot
+                meta[i, nb + 1] = len(req.prompt)
+                meta[i, nb + 2] = req.top_k
+                meta[i, nb + 3] = req.seed
+                fmeta[i] = (req.temperature, req.top_p)
+                if self.paged:
+                    a = allocs[i]
+                    hit = a.n_hit * self.kv_page_size
+                    sfx = req.prompt[hit:]
+                    prompts[i, :len(sfx)] = sfx
+                    meta[i, :len(a.table)] = a.table
+                    meta[i, nb + 4] = hit
+                else:
+                    prompts[i, :len(req.prompt)] = req.prompt
             prompts_dev = jnp.asarray(prompts)
-            self._pool, toks = self._prefill(
-                self.params, self._pool, prompts_dev, true_lens,
-                slots_dev, temps, top_ks, top_ps, seeds)
-            # First tokens flow device-to-device into the slot state; the
-            # host copy below is for result lists and finish checks only.
-            self._state = self._admit(self._state, slots_dev, true_lens,
-                                      toks, temps, top_ks, top_ps, seeds)
+            meta_dev = jnp.asarray(meta)
+            fmeta_dev = jnp.asarray(fmeta)
+            self._pool, toks = self._prefill(self.params, self._pool,
+                                             prompts_dev, meta_dev,
+                                             fmeta_dev)
+            # First tokens flow device-to-device into the slot state;
+            # the host copy below is for result lists and finish checks
+            # only.
+            self._state = self._admit(self._state, toks, meta_dev,
+                                      fmeta_dev)
             if self._spec is not None and self._spec.drafter.kind == "device":
                 # The drafter ingests the SAME staged wave into its own
                 # pool (its frontier state is the engine's pos/tok, so
-                # prompt K/V is all it needs).
-                self._spec.drafter.prefill_wave(prompts_dev, slots_dev)
+                # prompt K/V is all it needs). Paged drafters share the
+                # engine's block ids: one table, two parallel pools.
+                self._spec.drafter.prefill_wave(prompts_dev, meta_dev)
             # jaxlint: disable=host-sync -- first-token readback feeds results/eos checks
             toks_host = np.asarray(toks)
             now = time.monotonic()
@@ -919,6 +1171,11 @@ class Engine:
                 sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
                 self._queue_wait.observe(self.steps - sub_step)
                 self._ttft.observe(now - sub_t)
+                alloc = allocs[i] if self.paged else None
+                if self.paged:
+                    self._ttft_prefix.labels(
+                        prefix="hit" if alloc.n_hit else "miss").observe(
+                            now - sub_t)
                 self.tracer.end(queued_sid,
                                 {"wait_steps": self.steps - sub_step})
                 gen_sid = self.tracer.begin(
@@ -926,7 +1183,7 @@ class Engine:
                     args={"slot": slot, "bucket": bucket})
                 st = _Active(req=req, slot=slot,
                              tokens=[int(toks_host[i])], first_token_t=now,
-                             span=gen_sid)
+                             span=gen_sid, alloc=alloc)
                 self._active[slot] = st
                 done = self._maybe_finish(st)
                 if done is not None:
@@ -970,7 +1227,8 @@ class Engine:
                 drafts[slot, :len(prop)] = prop
         else:
             drafts = drafter.draft(self._state["tok"], self._state["pos"],
-                                   self._state["active"])
+                                   self._state["active"],
+                                   table=self._state.get("table"))
             for slot, cap in caps.items():
                 dl[slot] = max(cap, 0)
         self._pool, self._state, emitted, counts, accepted = \
@@ -1074,18 +1332,38 @@ class Engine:
         workload so the reported percentiles describe the measured
         traffic, not compile-time."""
         self._ttft.reset()
+        self._ttft_prefix.reset()
         self._tpot.reset()
         self._queue_wait.reset()
         self._rate_ring.clear()
         self._spec_accept_len.reset()
         self._spec_req_accepted.reset()
         self.tracer.clear()
+        if self.block_pool is not None:
+            # Hit rates and capacity means should describe the measured
+            # workload too — warmup prompts are synthetic and all-miss.
+            self.block_pool.reset_ledger()
         if self._spec is not None:
             # Acceptance rate should describe the measured workload too —
             # warmup prompts are degenerate (all-zero) and would skew it.
             self._spec.steps = 0
             self._spec.drafted = 0
             self._spec.accepted = 0
+
+    def reset_prefix_cache(self) -> None:
+        """Drop every cached prefix block back to the free list. Only
+        legal on an idle engine (no active requests hold cache refs) —
+        warmup calls this so its synthetic prompts can never serve a
+        hit to live traffic, and tests use it to force cold-cache
+        baselines. The hit/miss token ledger resets with it (the rate
+        should describe the traffic after the reset)."""
+        if not self.paged:
+            return
+        if self._active:
+            raise RuntimeError(
+                "reset_prefix_cache on a busy engine: active requests "
+                "hold references into the radix cache")
+        self.block_pool.reset_cache()
 
     def _maybe_finish(self, state: _Active) -> Optional[Result]:
         import jax.numpy as jnp
@@ -1105,6 +1383,15 @@ class Engine:
         # pre-release state it was dispatched with.
         self._state = self._release(self._state,
                                     jnp.asarray(state.slot, jnp.int32))
+        if state.alloc is not None:
+            # Host block release: deref the hit chain, DONATE the full
+            # prompt blocks to the radix cache, free the rest. Safe even
+            # with a ride-along decode step in flight: that step was
+            # dispatched with the old table and only ever writes the
+            # row's generated-region frontier block — never a donated
+            # (prompt-only) block — and any reallocation's prefill
+            # queues behind it, overwriting its garbage block-for-block.
+            self.block_pool.release(state.alloc)
         self.completed += 1
         self._c_completed.labels(reason=reason).inc()
         self.tracer.end(state.span, {"tokens": len(state.tokens),

@@ -1,0 +1,390 @@
+"""Host-side block accounting for the paged KV pool: a free-list
+allocator over fixed-size KV blocks plus a refcounted radix prefix
+cache (vLLM's PagedAttention block tables, Kwon et al. 2023; SGLang's
+RadixAttention prefix reuse, Zheng et al. 2024 — scoped to this
+engine's fixed-shape discipline).
+
+Division of labor: everything HERE is plain Python over block *ids* —
+no jax import, testable without a device, cheap enough to run between
+decode steps (the scheduler.py contract). The device side (the actual
+(num_blocks, H, page, D) K/V arrays, the (num_slots, max_blocks) block
+table threaded through the slot state, and the compiled gather/scatter
+programs) lives in models/gpt.py + serve/engine.py.
+
+Allocation contract — full reservation at admission:
+
+  A request is admitted with ALL the blocks it can ever touch:
+  ceil((prompt_len + max_new_tokens) / page) minus the blocks a prefix
+  hit shares. Elasticity comes from reserving a request's ACTUAL need
+  instead of the dense pool's worst-case (num_slots, max_len) row, and
+  from shared prefix blocks being refcounted rather than copied — not
+  from mid-decode growth. The decode hot loop therefore still uploads
+  NOTHING from the host (the block table is written once, at admit),
+  and pool exhaustion mid-decode is impossible by construction: an
+  admitted request never asks for another block, so the no-deadlock
+  argument is one line. Requests whose need cannot be met wait in the
+  FIFO queue (counted as stall steps) instead of deadlocking; a request
+  that could NEVER fit (need > the whole pool) is rejected at submit.
+
+Prefix sharing — block-aligned, copy-on-write by refcount:
+
+  The radix cache is a trie keyed on PAGE-sized token blocks. Only FULL
+  prompt blocks are shareable, so the shared region of any request is
+  block-aligned and the frontier block — the only block anything ever
+  writes — is always private. "Copy-on-write" therefore degenerates to
+  copy-on-extend at block granularity: a shared (refcount > 1) block is
+  never written by anyone; divergence after a shared prefix lands in
+  each request's own private blocks, and the partially-matching tail
+  block of a prompt simply re-prefills into a private block (that
+  re-prefill IS the copy). A hit is additionally capped one token short
+  of the prompt so the suffix forward always has >= 1 token to compute
+  the first sampled logit from (the SGLang trick).
+
+  On release the request's full prompt blocks are DONATED to the trie
+  (refcount 0, evictable) instead of freed — the next request sharing
+  that prefix skips their prefill entirely. Eviction is LRU over
+  refcount-zero leaves, run lazily when an allocation comes up short.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+
+def blocks_for(n_positions: int, page: int) -> int:
+    """ceil(n_positions / page): blocks covering n_positions tokens."""
+    return -(-n_positions // page)
+
+
+class _Node:
+    """One cached block: a trie edge keyed by its page of token ids."""
+
+    __slots__ = ("key", "block", "parent", "children", "refs", "last_use")
+
+    def __init__(self, key, block: int, parent):
+        self.key = key                  # tuple of page token ids
+        self.block = block              # pool block id holding its K/V
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.refs = 0                   # in-flight requests sharing it
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Refcounted radix/trie prefix cache over page-sized token blocks.
+
+    Pure block-id bookkeeping (the K/V bytes stay in the device pool,
+    untouched — a cached block's content is immutable because nothing
+    ever writes a non-private block). Single-threaded by design, like
+    the engine that owns it."""
+
+    def __init__(self, page: int):
+        if page < 1:
+            raise ValueError(f"page must be >= 1, got {page}")
+        self.page = page
+        self.root = _Node(key=None, block=-1, parent=None)
+        self._nodes: List[_Node] = []   # every live node (small pools)
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _keys(self, prompt: Sequence[int], n_blocks: int) -> List[tuple]:
+        p = self.page
+        return [tuple(prompt[i * p:(i + 1) * p]) for i in range(n_blocks)]
+
+    def match(self, prompt: Sequence[int]) -> List[_Node]:
+        """The resident chain of FULL prompt blocks, longest first-match
+        walk from the root — capped one token short of the prompt so the
+        suffix prefill always has a token to run (module docstring).
+        Touches the chain's LRU clocks."""
+        usable = (len(prompt) - 1) // self.page
+        path: List[_Node] = []
+        node = self.root
+        for key in self._keys(prompt, usable):
+            child = node.children.get(key)
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        self._tick += 1
+        for n in path:
+            n.last_use = self._tick
+        return path
+
+    def acquire(self, node: _Node) -> None:
+        node.refs += 1
+
+    def release(self, node: _Node) -> None:
+        if node.refs <= 0:
+            raise RuntimeError("prefix-cache refcount underflow")
+        node.refs -= 1
+
+    def insert_chain(self, prompt: Sequence[int], blocks: Sequence[int],
+                     start: int) -> List[int]:
+        """Donate ``blocks[start:full]`` (a finished request's private
+        full-prompt blocks; blocks[:start] are its hit chain, already in
+        the trie) as cached nodes. Returns the block ids NOT absorbed —
+        duplicates of chains another request donated first — which the
+        caller must free (their content is identical: same tokens, same
+        deterministic prefill)."""
+        full = len(prompt) // self.page
+        keys = self._keys(prompt, full)
+        node = self.root
+        for key in keys[:start]:
+            node = node.children[key]   # the hit chain: must exist
+        dup: List[int] = []
+        self._tick += 1
+        for i in range(start, full):
+            child = node.children.get(keys[i])
+            if child is None:
+                child = _Node(keys[i], blocks[i], node)
+                node.children[keys[i]] = child
+                self._nodes.append(child)
+            else:
+                dup.append(blocks[i])
+            child.last_use = self._tick
+            node = child
+        return dup
+
+    def evictable(self) -> int:
+        """Blocks reclaimable RIGHT NOW by repeated leaf eviction: nodes
+        with refs == 0 and no pinned descendant (a refs-0 parent of a
+        pinned child must stay — the child's prefix walk crosses it)."""
+        pinned = set()
+        for n in self._nodes:
+            if n.refs > 0:
+                while n is not None and id(n) not in pinned:
+                    pinned.add(id(n))
+                    n = n.parent
+        return sum(1 for n in self._nodes if id(n) not in pinned)
+
+    def evict(self, want: int) -> List[int]:
+        """Free up to ``want`` blocks, LRU refcount-zero leaves first
+        (a parent becomes a leaf once its children are gone). Returns
+        the freed block ids."""
+        freed: List[int] = []
+        while len(freed) < want:
+            victim = None
+            for n in self._nodes:
+                if n.refs == 0 and not n.children and (
+                        victim is None or n.last_use < victim.last_use):
+                    victim = n
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self._nodes.remove(victim)
+            freed.append(victim.block)
+        return freed
+
+    def cached_blocks(self) -> List[int]:
+        return [n.block for n in self._nodes]
+
+
+@dataclass
+class Allocation:
+    """One admitted request's block-level state: the full table row the
+    device side scatters, and the host bookkeeping release() unwinds."""
+    prompt: tuple
+    table: List[int]                 # hit chain + private blocks, in order
+    n_hit: int                       # leading shared (trie) blocks
+    nodes: List[_Node] = field(default_factory=list)   # acquired chain
+
+
+class BlockPool:
+    """Free-list allocator + radix prefix cache over ``num_blocks`` KV
+    blocks of ``page`` positions each.
+
+    States (the serve_kv_pool_blocks gauge): ``free`` blocks sit on the
+    free list; ``cached`` blocks live in the trie with refcount 0
+    (reclaimable); ``live`` blocks are referenced by an in-flight
+    request — privately owned, or shared trie blocks with refs > 0.
+    The three partition [0, num_blocks) at all times (pinned by the
+    fuzz test's invariant checker)."""
+
+    def __init__(self, num_blocks: int, page: int, *,
+                 prefix_cache: bool = True):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.page = page
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self.cache = RadixPrefixCache(page) if prefix_cache else None
+        # One-entry match memo: the engine probes a request's hit twice
+        # per admission attempt (suffix-bucket wave key, then admit) —
+        # same prompt, same instant, no mutation between — so the second
+        # trie walk is pure waste. Invalidated by anything that changes
+        # match results (insertion, eviction, reset).
+        self._match_memo: Optional[tuple] = None
+        # Telemetry ledger (plain ints; the engine mirrors them into the
+        # obs registry at collection time — zero hot-loop cost).
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.stall_steps = 0            # wave heads deferred on blocks
+        self.evicted_blocks = 0
+        self.requests = 0
+        self.private_blocks_allocated = 0
+
+    # -- sizing -----------------------------------------------------------
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return blocks_for(prompt_len + max_new, self.page)
+
+    def _match(self, prompt: Sequence[int]) -> List[_Node]:
+        key = tuple(prompt)
+        if self._match_memo is not None and self._match_memo[0] == key:
+            return self._match_memo[1]
+        chain = self.cache.match(key) if self.cache is not None else []
+        self._match_memo = (key, chain)
+        return chain
+
+    def match_len(self, prompt: Sequence[int]) -> int:
+        """Non-mutating-ownership probe: tokens a hit would skip (the
+        engine buckets the SUFFIX with this before committing blocks)."""
+        return len(self._match(prompt)) * self.page
+
+    # -- admit / release --------------------------------------------------
+    def _take(self, want: int) -> Optional[List[int]]:
+        """Pop ``want`` free blocks, evicting LRU cached blocks to cover
+        a shortfall; None (and nothing consumed) when even eviction
+        cannot cover it."""
+        short = want - len(self._free)
+        if short > 0 and self.cache is not None:
+            freed = self.cache.evict(short)
+            if freed:
+                self.evicted_blocks += len(freed)
+                self._free.extend(freed)
+                self._match_memo = None
+        if want > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(want)]
+
+    def admit(self, prompt: Sequence[int],
+              max_new: int) -> Optional[Allocation]:
+        """Match the prompt against the prefix cache, acquire the hit
+        chain, and allocate private blocks for everything else (suffix
+        prefill + the whole generation budget). None when the pool
+        cannot cover it — the caller leaves the request queued.
+
+        The chain is acquired BEFORE the private allocation: _take's
+        shortfall eviction reclaims refcount-zero blocks, and an
+        unpinned just-matched chain is exactly that — evicting it would
+        hand the same block out as both "shared prefix, never written"
+        and "fresh private, about to be written" (an aliased table and
+        silently corrupt K/V). Pinning first means a pool that can only
+        fit the request by sacrificing its own hit DEFERS instead —
+        correctness over one admission's latency."""
+        nodes = self._match(prompt)
+        n_hit = len(nodes)
+        total = self.blocks_needed(len(prompt), max_new)
+        for n in nodes:
+            self.cache.acquire(n)
+        fresh = self._take(total - n_hit)
+        if fresh is None:
+            for n in nodes:
+                self.cache.release(n)
+            self.stall_steps += 1
+            return None
+        hit = n_hit * self.page
+        self.hit_tokens += hit
+        self.miss_tokens += len(prompt) - hit
+        self.requests += 1
+        self.private_blocks_allocated += total - n_hit
+        return Allocation(prompt=tuple(prompt),
+                          table=[n.block for n in nodes] + fresh,
+                          n_hit=n_hit, nodes=nodes)
+
+    def release(self, alloc: Allocation) -> None:
+        """Unwind one finished request: deref its hit chain, donate its
+        full prompt blocks to the trie, free the rest (generated-region
+        blocks + donation duplicates)."""
+        for n in alloc.nodes:
+            self.cache.release(n)
+        full = len(alloc.prompt) // self.page
+        if self.cache is not None:
+            dup = self.cache.insert_chain(alloc.prompt, alloc.table,
+                                          alloc.n_hit)
+            self._free.extend(dup)
+            self._free.extend(alloc.table[full:])
+            self._match_memo = None
+        else:
+            self._free.extend(alloc.table[alloc.n_hit:])
+
+    def reset_cache(self) -> None:
+        """Evict every cached block back to the free list and zero the
+        hit/miss ledger. Callers must ensure no live allocation holds
+        cache references (the engine checks it is idle first) — with
+        refs all zero, repeated leaf eviction drains the whole trie."""
+        if self.cache is None:
+            return
+        self._free.extend(self.cache.evict(self.num_blocks))
+        self._match_memo = None
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+
+    def reset_ledger(self) -> None:
+        """Zero the telemetry counters (hit/miss tokens, stalls,
+        evictions, per-request allocation means) WITHOUT touching
+        allocation state — benchmarks call this between warmup and the
+        timed workload so hit rates and capacity describe the measured
+        traffic (the engine's reset_latency_stats contract)."""
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.stall_steps = 0
+        self.evicted_blocks = 0
+        self.requests = 0
+        self.private_blocks_allocated = 0
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        free = len(self._free)
+        cached = evictable = 0
+        if self.cache is not None:
+            cached = len(self.cache)
+            evictable = self.cache.evictable()
+        seen = self.hit_tokens + self.miss_tokens
+        return {
+            "num_blocks": self.num_blocks,
+            "page": self.page,
+            "free": free,
+            # Gauge semantics (class docstring): cached = trie blocks at
+            # refs 0 (reclaimable), live = everything a request holds.
+            "cached": evictable,
+            "live": self.num_blocks - free - evictable,
+            "trie_blocks": cached,
+            "prefix_hit_tokens": self.hit_tokens,
+            "prefix_miss_tokens": self.miss_tokens,
+            "prefix_hit_rate": (self.hit_tokens / seen) if seen else None,
+            "block_stall_steps": self.stall_steps,
+            "evicted_blocks": self.evicted_blocks,
+            "mean_private_blocks_per_request": (
+                self.private_blocks_allocated / self.requests
+                if self.requests else None),
+        }
+
+    def check(self, live_allocs: Sequence[Allocation] = ()) -> None:
+        """Invariant audit (tests call this after every fuzz step): the
+        free list, the trie, and the live allocations' private blocks
+        partition [0, num_blocks) with no overlap; refcounts equal the
+        number of live allocations holding each node."""
+        free = list(self._free)
+        assert len(set(free)) == len(free), "free-list duplicate"
+        cached = self.cache.cached_blocks() if self.cache else []
+        assert len(set(cached)) == len(cached), "trie duplicate block"
+        assert not set(free) & set(cached), "block both free and cached"
+        owned: List[int] = []
+        refs: Dict[int, int] = {}
+        for a in live_allocs:
+            owned.extend(a.table[a.n_hit:])
+            for n in a.nodes:
+                refs[id(n)] = refs.get(id(n), 0) + 1
+        assert len(set(owned)) == len(owned), "block owned twice"
+        assert not set(owned) & set(free), "live block on free list"
+        assert not set(owned) & set(cached), "private block in trie"
+        every = set(free) | set(cached) | set(owned)
+        assert every == set(range(self.num_blocks)), (
+            f"pool partition broken: {len(every)}/{self.num_blocks}")
+        if self.cache is not None:
+            for n in self.cache._nodes:
+                assert n.refs == refs.get(id(n), 0), (
+                    "refcount drift", n.key, n.refs, refs.get(id(n), 0))

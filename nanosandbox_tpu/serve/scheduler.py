@@ -122,7 +122,8 @@ class SlotScheduler:
         (item,), (slot,), bucket = wave
         return item, slot, bucket
 
-    def next_admission_wave(self, max_items: Optional[int] = None,
+    def next_admission_wave(self, max_items: Optional[int] = None, *,
+                            bucket_of=None, admit=None,
                             ) -> Optional[Tuple[List, List[int], int]]:
         """(items, slots, bucket): the maximal FIFO *prefix* of the queue
         whose prompts share the head's prefill bucket, capped at the free
@@ -132,18 +133,36 @@ class SlotScheduler:
         Strictly a prefix — a queued request with a different bucket ends
         the wave rather than being jumped over, so admission order stays
         FIFO (the starvation-free guarantee above) even though same-bucket
-        runs now land together."""
+        runs now land together.
+
+        ``bucket_of(item) -> int`` overrides the default
+        bucket_for(len(item.prompt)) wave key — the paged engine buckets
+        the prefix-cache-adjusted SUFFIX, so two requests sharing a
+        resident system prompt land in one small-suffix wave.
+
+        ``admit(item) -> bool`` is the paged engine's block-availability
+        gate, called BEFORE the pop and expected to commit resources on
+        True: a False return fences the wave with the item still queued
+        (FIFO again — nothing behind a block-starved head jumps it, which
+        with full-reservation allocation is what makes pool exhaustion a
+        wait instead of a deadlock)."""
         if not self._queue or not self._free:
             return None
-        bucket = self.bucket_for(len(self._queue[0].prompt))
+        key = bucket_of if bucket_of is not None else (
+            lambda item: self.bucket_for(len(item.prompt)))
+        bucket = key(self._queue[0])
         items: List = []
         slots: List[int] = []
         while (self._queue and self._free
                and (max_items is None or len(items) < max_items)):
-            if self.bucket_for(len(self._queue[0].prompt)) != bucket:
+            if key(self._queue[0]) != bucket:
+                break
+            if admit is not None and not admit(self._queue[0]):
                 break
             items.append(self._queue.popleft())
             slots.append(self._free.pop())
+        if not items:
+            return None
         return items, slots, bucket
 
     def release(self, slot: int) -> None:

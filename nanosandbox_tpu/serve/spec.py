@@ -65,7 +65,8 @@ class SpecRunner:
 
     def __init__(self, drafter, *, model, num_slots: int, max_len: int,
                  n_prefill_programs: int, registry, on_accel: bool,
-                 kv_dtype=None, decode_impl=None):
+                 kv_dtype=None, decode_impl=None, paged: bool = False,
+                 kv_page_size: int = 0, kv_pool_blocks: int = 0):
         import jax
 
         self.drafter = drafter
@@ -84,12 +85,15 @@ class SpecRunner:
             # serving an int8 target should not quietly hold a
             # full-precision cache — nor keep running a kernel the
             # operator pinned AWAY from (--decode_impl=xla must reach
-            # the drafter's T=1 draft steps too).
+            # the drafter's T=1 draft steps too). Paged engines page the
+            # drafter pool the same way: one shared block table, two
+            # parallel pools indexed by the same block ids.
             self.programs.update(drafter.build(
                 target_cfg=model.cfg, num_slots=num_slots, max_len=max_len,
                 n_prefill_programs=n_prefill_programs, registry=registry,
                 on_accel=on_accel, kv_dtype=kv_dtype,
-                decode_impl=decode_impl))
+                decode_impl=decode_impl, paged=paged,
+                kv_page_size=kv_page_size, kv_pool_blocks=kv_pool_blocks))
         self._verify = jax.jit(
             registry.guard("verify", self.programs["verify"])(
                 self._verify_fn),
@@ -153,7 +157,8 @@ class SpecRunner:
         toks_in = jnp.concatenate([state["tok"][:, None], drafts], axis=1)
         logits, pool = self.model.apply({"params": params}, toks_in,
                                         deterministic=True, cache=pool,
-                                        cache_index=state["pos"])
+                                        cache_index=state["pos"],
+                                        block_table=state.get("table"))
         logits = logits.astype(jnp.float32)              # (S, K+1, V)
         V = logits.shape[-1]
         t = state["temp"]

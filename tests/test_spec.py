@@ -366,12 +366,20 @@ def test_model_drafter_cache_consistent_after_full_accept(served_model,
     # a tiny model's argmax, a real stale row is not).
     junk = [int(x) for x in np.random.default_rng(3).integers(
         0, cfg.vocab_size, 24)]
+
+    def meta(slots):
+        # The engine's packed dense staging row ([slot | true_len |
+        # top_k | seed]); the drafter prefill reads only the slot col.
+        m = np.zeros((len(slots), 4), np.int32)
+        m[:, 0] = slots
+        return jnp.asarray(m)
+
     drafter.prefill_wave(jnp.asarray([junk, junk], jnp.int32),
-                         jnp.asarray([0, 1], jnp.int32))
+                         meta([0, 1]))
     prompt = [1, 2, 3, 4, 5]
     L = len(prompt)
     drafter.prefill_wave(jnp.asarray([prompt, prompt], jnp.int32),
-                         jnp.asarray([0, 1], jnp.int32))
+                         meta([0, 1]))
 
     def dense_greedy(seq, n):
         out = []
