@@ -207,17 +207,31 @@ class MetricFamily:
         self._children: Dict[Tuple[str, ...], _Child] = {}
         self._lock = threading.Lock()
 
-    def labels(self, **labels: object) -> _Child:
+    def _key(self, labels: dict) -> Tuple[str, ...]:
         if tuple(sorted(labels)) != tuple(sorted(self.labelnames)):
             raise ValueError(
                 f"{self.name} takes labels {self.labelnames}, "
                 f"got {tuple(sorted(labels))}")
-        key = tuple(str(labels[n]) for n in self.labelnames)
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def labels(self, **labels: object) -> _Child:
+        key = self._key(labels)
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = _Child(self, key)
             return child
+
+    def peek(self, **labels: object) -> Optional[_Child]:
+        """The child for this label combination IF it exists — never
+        creates one. The read-side twin of labels(): Engine.stats()
+        reads series this way so a feature that never recorded (prefix
+        cache off, spec off) never mints an empty series that the
+        exposition would then render as a placeholder (the /metrics
+        label-hygiene rule, pinned by test)."""
+        key = self._key(labels)
+        with self._lock:
+            return self._children.get(key)
 
     def _default(self) -> _Child:
         if self.labelnames:
@@ -225,7 +239,9 @@ class MetricFamily:
                 f"{self.name} is labeled {self.labelnames}; use .labels()")
         return self.labels()
 
-    # label-less conveniences
+    # label-less conveniences: WRITES create the () child; READS peek
+    # (a family nothing ever recorded to must stay series-less so the
+    # exposition skips it — reading stats() is not recording).
     def inc(self, amount: float = 1.0) -> None:
         self._default().inc(amount)
 
@@ -239,14 +255,17 @@ class MetricFamily:
         self._default().observe(value)
 
     def mean(self):
-        return self._default().mean()
+        child = self.peek()
+        return None if child is None else child.mean()
 
     def percentiles(self, ps: tuple = (50, 90, 99)):
-        return self._default().percentiles(ps)
+        child = self.peek()
+        return None if child is None else child.percentiles(ps)
 
     @property
     def value(self):
-        return self._default().value
+        child = self.peek()
+        return None if child is None else child.value
 
     def series(self) -> List[Tuple[Tuple[str, ...], _Child]]:
         with self._lock:

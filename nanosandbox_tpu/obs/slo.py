@@ -1,0 +1,171 @@
+"""Per-request SLO accounting: deadlines, attainment, goodput.
+
+ROADMAP item 3's scheduler work will be judged on "goodput under
+overload" — which needs a ledger BEFORE it needs a policy.  This module
+is that ledger (ISSUE 10): each request may carry a ``deadline_s``
+(submit-to-finish budget) and an ``slo_class`` label; at its terminal
+event the engine records the outcome here, and the ledger publishes:
+
+  * ``serve_slo_requests_total{slo_class=,outcome=}`` — outcome is
+    ``met`` (finished within deadline), ``missed`` (finished late) or
+    ``shed`` (dropped from the queue after its deadline expired);
+  * ``serve_goodput_tokens_total{slo_class=}`` — tokens of requests
+    that FINISHED WITHIN DEADLINE; the overload sweep's goodput is
+    rate() over this, and `bench.py --mode=serve` pins it;
+  * ``serve_slo_attainment{slo_class=}`` — met / (met+missed+shed),
+    mirrored at collection time;
+  * ``serve_deadline_margin_seconds{slo_class=,prefix=}`` — histogram
+    of (deadline - end-to-end latency) at finish, split by prefix-cache
+    outcome: negative margin IS the miss, and the hit/miss split shows
+    how much of the attainment budget the prefix cache is buying.
+
+Hot-loop cost follows the PR 5 contract: terminal events update plain
+ints (+ one histogram observe); counters and the attainment gauge are
+mirrored by a collector per scrape.  Requests WITHOUT a deadline are
+not SLO-tracked at all — their label children are never created, so a
+deployment that never sets deadlines scrapes no placeholder SLO series
+(the label-hygiene rule).  No jax import (the obs/ contract).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+# Margin buckets (seconds): symmetric around 0 — the miss boundary —
+# so histogram_quantile and a burn-rate query both resolve "how late".
+MARGIN_BUCKETS = (-60.0, -10.0, -5.0, -1.0, -0.5, -0.1, 0.0,
+                  0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+# Class names become Prometheus label values; a bounded charset keeps
+# an open HTTP surface from minting unbounded series cardinality.
+_CLASS_RE = re.compile(r"^[a-zA-Z0-9_.\-]{1,32}$")
+DEFAULT_CLASS = "default"
+
+
+def validate_slo_class(slo_class: str) -> str:
+    if not _CLASS_RE.match(slo_class):
+        raise ValueError(
+            f"slo_class {slo_class!r} must match {_CLASS_RE.pattern}")
+    return slo_class
+
+
+class _ClassLedger:
+    __slots__ = ("met", "missed", "shed", "goodput_tokens", "late_tokens")
+
+    def __init__(self):
+        self.met = 0
+        self.missed = 0
+        self.shed = 0
+        self.goodput_tokens = 0
+        self.late_tokens = 0
+
+
+class SLOLedger:
+    """Plain-int per-class deadline accounting, mirrored into an
+    ``obs.MetricRegistry`` at collection time. Owned by the Engine
+    (one per engine, on the engine's registry)."""
+
+    def __init__(self, registry):
+        self._classes: Dict[str, _ClassLedger] = {}
+        self._c_req = registry.counter(
+            "serve_slo_requests_total",
+            "Deadline-carrying requests by terminal outcome.",
+            labelnames=("slo_class", "outcome"))
+        self._c_goodput = registry.counter(
+            "serve_goodput_tokens_total",
+            "Tokens of requests that finished within their deadline.",
+            labelnames=("slo_class",))
+        self._g_attain = registry.gauge(
+            "serve_slo_attainment",
+            "met / (met + missed + shed) per SLO class.",
+            labelnames=("slo_class",))
+        self._h_margin = registry.histogram(
+            "serve_deadline_margin_seconds",
+            "deadline_s minus end-to-end latency at finish (negative = "
+            "missed), by class and prefix-cache outcome.",
+            unit="seconds", labelnames=("slo_class", "prefix"),
+            buckets=MARGIN_BUCKETS)
+        registry.add_collector(self._collect)
+
+    def _cls(self, slo_class: str) -> _ClassLedger:
+        led = self._classes.get(slo_class)
+        if led is None:
+            led = self._classes[slo_class] = _ClassLedger()
+        return led
+
+    # ------------------------------------------------------------ record
+    def record_finish(self, slo_class: str, *, tokens: int,
+                      elapsed_s: float, deadline_s: Optional[float],
+                      prefix: str = "miss") -> Optional[bool]:
+        """Terminal accounting for a finished request. Returns whether
+        the deadline was met (None when the request carried none — such
+        requests are not SLO-tracked)."""
+        if deadline_s is None:
+            return None
+        led = self._cls(slo_class)
+        met = elapsed_s <= deadline_s
+        if met:
+            led.met += 1
+            led.goodput_tokens += tokens
+        else:
+            led.missed += 1
+            led.late_tokens += tokens
+        self._h_margin.labels(slo_class=slo_class,
+                              prefix=prefix).observe(deadline_s - elapsed_s)
+        return met
+
+    def record_shed(self, slo_class: str) -> None:
+        """A queued request dropped after its deadline expired — counts
+        against attainment; it produced zero (good) tokens."""
+        self._cls(slo_class).shed += 1
+
+    # ------------------------------------------------------------- views
+    def _collect(self) -> None:
+        for name, led in list(self._classes.items()):
+            self._c_req.labels(slo_class=name,
+                               outcome="met")._set_total(led.met)
+            self._c_req.labels(slo_class=name,
+                               outcome="missed")._set_total(led.missed)
+            self._c_req.labels(slo_class=name,
+                               outcome="shed")._set_total(led.shed)
+            self._c_goodput.labels(slo_class=name)._set_total(
+                led.goodput_tokens)
+            total = led.met + led.missed + led.shed
+            self._g_attain.labels(slo_class=name).set(
+                led.met / total if total else 0.0)
+
+    def stats(self) -> dict:
+        """The Engine.stats()["slo"] view: per-class dicts plus the
+        cross-class rollup bench.py's overload sweep reads."""
+        classes = {}
+        met = missed = shed = goodput = late = 0
+        for name, led in sorted(self._classes.items()):
+            total = led.met + led.missed + led.shed
+            classes[name] = {
+                "met": led.met, "missed": led.missed, "shed": led.shed,
+                "goodput_tokens": led.goodput_tokens,
+                "late_tokens": led.late_tokens,
+                "attainment": (led.met / total) if total else None,
+            }
+            met += led.met
+            missed += led.missed
+            shed += led.shed
+            goodput += led.goodput_tokens
+            late += led.late_tokens
+        total = met + missed + shed
+        return {"classes": classes,
+                "overall": {"met": met, "missed": missed, "shed": shed,
+                            "goodput_tokens": goodput,
+                            "late_tokens": late,
+                            "attainment": (met / total) if total else None}}
+
+    def reset(self) -> None:
+        """Zero the ledger (benchmarks reset between warmup and the
+        timed window). Existing label children reset too — a cleared
+        class would otherwise freeze its last mirrored totals on the
+        scrape forever."""
+        self._classes.clear()
+        for fam in (self._c_req, self._c_goodput, self._g_attain,
+                    self._h_margin):
+            fam.reset()

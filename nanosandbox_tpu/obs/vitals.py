@@ -1,0 +1,109 @@
+"""Process vitals for /metrics: host health next to engine counters.
+
+A scrape that shows TTFT p99 climbing but nothing about WHY is half a
+dashboard — RSS creep, fd exhaustion and device-buffer growth are the
+classic serving slow-deaths, and none of them live in any engine
+counter.  ``register_process_vitals`` adds collection-time gauges to a
+registry (the process-global one by default):
+
+  process_resident_memory_bytes   current RSS (/proc/self/statm;
+                                  ru_maxrss high-water fallback)
+  process_open_fds                /proc/self/fd count
+  process_start_time_seconds      unix time this module first registered
+  process_uptime_seconds          seconds since then
+  jax_live_buffer_bytes           sum of nbytes over jax.live_arrays()
+  jax_live_buffer_count           len(jax.live_arrays())
+
+Everything is sampled AT COLLECTION TIME (per scrape) — zero hot-loop
+cost, the PR 5 collector contract.  This module imports no jax: the
+buffer gauges read ``jax.live_arrays()`` only when jax is ALREADY in
+sys.modules (a process that never touched jax must not initialize a
+backend because Prometheus scraped it), and ``nbytes`` is shape
+metadata — no device sync, so the no-new-host-syncs ledger assertion
+holds with vitals registered.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import sys
+import time
+from typing import Optional
+
+from nanosandbox_tpu.obs.registry import MetricRegistry, global_registry
+
+_START_WALL = time.time()
+
+
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * (resource.getpagesize())
+    except (OSError, ValueError, IndexError):
+        pass
+    # Portable fallback: the high-water mark (KB on Linux, bytes on
+    # macOS — normalize Linux's KB).
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    if rss <= 0:
+        return None
+    return rss * 1024 if sys.platform.startswith("linux") else rss
+
+
+def _open_fds() -> Optional[int]:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def register_process_vitals(registry: Optional[MetricRegistry] = None,
+                            ) -> MetricRegistry:
+    """Idempotently add the vitals gauges + collector to ``registry``
+    (default: the process-global registry). Returns the registry."""
+    reg = registry if registry is not None else global_registry()
+    # Once per registry OBJECT (a flag on the instance, not an id() set:
+    # a recycled address must not silently skip a fresh registry) —
+    # re-registering would double-add the collector; the families
+    # themselves are idempotent by registry semantics.
+    if getattr(reg, "_vitals_registered", False):
+        return reg
+    reg._vitals_registered = True
+    g_rss = reg.gauge("process_resident_memory_bytes",
+                      "Resident set size of this process.", unit="bytes")
+    g_fds = reg.gauge("process_open_fds",
+                      "Open file descriptors of this process.")
+    g_start = reg.gauge("process_start_time_seconds",
+                        "Unix time vitals were first registered.",
+                        unit="seconds")
+    g_uptime = reg.gauge("process_uptime_seconds",
+                         "Seconds since vitals were first registered.",
+                         unit="seconds")
+    g_jax_bytes = reg.gauge(
+        "jax_live_buffer_bytes",
+        "Total bytes of live jax arrays at collection time.",
+        unit="bytes")
+    g_jax_count = reg.gauge("jax_live_buffer_count",
+                            "Live jax arrays at collection time.")
+
+    def collect() -> None:
+        rss = _rss_bytes()
+        if rss is not None:
+            g_rss.set(rss)
+        fds = _open_fds()
+        if fds is not None:
+            g_fds.set(fds)
+        g_start.set(_START_WALL)
+        g_uptime.set(time.time() - _START_WALL)
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                arrs = jax.live_arrays()
+                g_jax_count.set(len(arrs))
+                # nbytes is ShapeDtype metadata — reading it syncs
+                # nothing (the no-new-host-syncs pin covers this).
+                g_jax_bytes.set(float(sum(a.nbytes for a in arrs)))
+            except Exception:
+                pass            # deleted-buffer races mid-iteration
+    reg.add_collector(collect)
+    return reg

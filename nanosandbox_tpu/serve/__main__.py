@@ -81,6 +81,23 @@ def main(argv: list[str] | None = None) -> None:
                          "default budgets/serve_cpu8.json, skipped "
                          "silently when absent — an EXPLICIT path must "
                          "exist; '' disables")
+    ap.add_argument("--deadline_s", type=float, default=0.0,
+                    help="default per-request SLO deadline in seconds "
+                         "(submit -> finish), applied to requests that "
+                         "send none; 0 = best-effort. Deadline-carrying "
+                         "requests land in the SLO ledger "
+                         "(serve_slo_* + serve_goodput_tokens_total on "
+                         "/metrics) and are SHED from the queue once "
+                         "expired (finish_reason 'shed')")
+    ap.add_argument("--watchdog_dir", default=None,
+                    help="directory for anomaly-watchdog dumps (flight "
+                         "ledger + span ring + stats snapshot per "
+                         "trip); default: a tempdir created on the "
+                         "first trip")
+    ap.add_argument("--no_watchdogs", action="store_true",
+                    help="disable the anomaly watchdogs (TTFT spike, "
+                         "admission stall, pool thrash, post-warmup "
+                         "retrace, stuck slot)")
     ap.add_argument("--warmup", choices=("full", "buckets"), default="full",
                     help="'full' compiles every (wave-size, bucket) "
                          "prefill pair before binding the port (the "
@@ -142,7 +159,10 @@ def main(argv: list[str] | None = None) -> None:
                     paged=args.paged == "on",
                     kv_page_size=args.kv_page_size,
                     kv_pool_blocks=args.kv_pool_blocks or None,
-                    prefix_cache=not args.no_prefix_cache)
+                    prefix_cache=not args.no_prefix_cache,
+                    watchdogs=not args.no_watchdogs,
+                    watchdog_dir=args.watchdog_dir,
+                    default_deadline_s=args.deadline_s or None)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -197,6 +217,18 @@ def main(argv: list[str] | None = None) -> None:
           + f" (pipeline={'on' if engine.pipeline else 'off'})",
           file=sys.stderr, flush=True)
     engine.reset_latency_stats()  # /stats should describe live traffic
+    # Post-warmup, ANY compile eats a live request's latency, so the
+    # watchdog marks steady in BOTH warmup modes: under --warmup=buckets
+    # the deliberate lazy wave compiles are exactly what an operator
+    # wants counted and dumped (the freeze doesn't cover that mode);
+    # under --warmup=full the tracecheck freeze makes a retrace fatal
+    # first, and the mark is a belt-and-braces backstop.
+    engine.watchdog.mark_steady()
+    # Host health on the same scrape as the engine counters: RSS, open
+    # fds, uptime, live jax buffer bytes — sampled per scrape.
+    from nanosandbox_tpu.obs import register_process_vitals
+
+    register_process_vitals()
     # Publish the pinned comms contract (shardcheck budget) as gauges on
     # the process-global registry so every /metrics scrape carries the
     # collective counts this deployment is budgeted for — a TP-serving
@@ -223,7 +255,8 @@ def main(argv: list[str] | None = None) -> None:
           f"decode_impl={engine.decode_impl}); prefill buckets "
           f"{engine.sched.buckets}; listening on "
           f"{args.host}:{args.port} (POST /generate, GET /healthz "
-          "/stats /metrics /trace, POST /profile)",
+          "/stats /metrics /trace /debug/requests /debug/slots "
+          "/debug/kvpool /debug/scheduler, POST /profile)",
           file=sys.stderr, flush=True)
     # After a FULL warmup the compile set is complete by contract, so
     # freeze the retrace budgets: a compile after /healthz went green

@@ -76,7 +76,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from nanosandbox_tpu.obs import MetricRegistry, SpanTracer
+from nanosandbox_tpu.obs import (FlightRecorder, MetricRegistry, SLOLedger,
+                                 SpanTracer, WatchdogPanel,
+                                 validate_slo_class)
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
 from nanosandbox_tpu.utils import tracecheck as _tracecheck
 from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
@@ -85,7 +87,9 @@ from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
 @dataclass(frozen=True)
 class Request:
     """One generation request, in token-id space (the HTTP layer owns
-    text <-> tokens)."""
+    text <-> tokens). ``deadline_s`` is the submit-to-finish SLO budget
+    (None = best-effort: never SLO-tracked, never shed); ``slo_class``
+    labels the request's SLO accounting on /metrics."""
     rid: int
     prompt: tuple
     max_new_tokens: int
@@ -94,6 +98,8 @@ class Request:
     top_p: float = 1.0
     seed: int = 0
     eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    slo_class: str = "default"
 
 
 @dataclass
@@ -101,7 +107,7 @@ class Result:
     rid: int
     prompt: tuple
     tokens: List[int]          # generated ids (includes the eos hit, if any)
-    finish_reason: str         # 'length' | 'eos'
+    finish_reason: str         # 'length' | 'eos' | 'shed'
 
 
 @dataclass
@@ -110,6 +116,8 @@ class _Active:
     slot: int
     tokens: List[int] = field(default_factory=list)
     first_token_t: float = 0.0   # wall clock of the prefill-token readback
+    submit_t: float = 0.0        # wall clock at submit (SLO end-to-end)
+    last_t: float = 0.0          # wall clock of the last retired token
     spec_accepted: int = 0       # draft tokens this request accepted
     span: int = 0                # open "generate" span id (obs tracer)
     alloc: object = None         # paged.Allocation (block-paged engines)
@@ -190,6 +198,23 @@ class Engine:
         — admission prefills only the (bucketed) suffix — with
         refcounted copy-on-write block sharing and LRU eviction of
         refcount-zero blocks (serve/paged.py).
+    flight : obs.FlightRecorder for the per-request lifecycle ledger
+        (default: a fresh bounded recorder). Records submit -> queue ->
+        block-reserve/stall -> admit -> prefill[hit|miss] -> retire* ->
+        evict -> finish|reject|shed, from already-host-resident
+        dispatch-time state only — no host sync, < 50 us/event (pinned).
+        Serves GET /debug/requests and the watchdog dumps.
+    watchdogs / watchdog_dir : anomaly watchdogs (obs.WatchdogPanel:
+        TTFT spike, admission stall, pool thrash, post-steady retrace,
+        stuck slot). A trip counts on watchdog_trips_total{kind=} and
+        snapshots flight + span ring + stats() into watchdog_dir
+        (default: a tempdir created on the first trip).
+    default_deadline_s : deadline applied to requests that submit none
+        (None = best-effort). A queued request whose deadline expires
+        before admission is SHED — a terminal 'shed' Result instead of
+        burning a slot on an answer its client stopped waiting for —
+        and every deadline-carrying request lands in the SLO ledger
+        (attainment, goodput tokens, deadline margin) on /metrics.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -202,7 +227,11 @@ class Engine:
                  decode_impl: Optional[str] = None,
                  paged: bool = True, kv_page_size: int = 16,
                  kv_pool_blocks: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 flight: Optional[FlightRecorder] = None,
+                 watchdogs: bool = True,
+                 watchdog_dir: Optional[str] = None,
+                 default_deadline_s: Optional[float] = None):
         import jax
         import jax.numpy as jnp
 
@@ -320,6 +349,12 @@ class Engine:
         self.admitted = 0
         self.completed = 0
         self.tokens_generated = 0
+        self.shed = 0                                # deadline-expired drops
+        self.rejected: Dict[str, int] = {}           # submit rejects, by kind
+        if default_deadline_s is not None and default_deadline_s <= 0:
+            raise ValueError(f"default_deadline_s must be > 0, got "
+                             f"{default_deadline_s}")
+        self.default_deadline_s = default_deadline_s
         # Telemetry spine (nanosandbox_tpu/obs): the latency signal
         # lives in registry histograms (RingStat window + Prometheus
         # buckets — /stats and /metrics read the SAME series), counters
@@ -408,6 +443,23 @@ class Engine:
             "serve_prefix_ttft_seconds",
             "Submit -> first-token seconds by prefix-cache outcome.",
             unit="seconds", labelnames=("prefix",))
+        # Overload/SLO observability (ISSUE 10): submit-time rejects and
+        # deadline sheds as mirrored counters, the SLO ledger (per-class
+        # attainment / goodput / deadline margins) on the same registry,
+        # the per-request flight recorder, and the anomaly watchdogs.
+        # Label children appear only when the events actually happen —
+        # a deadline-less deployment scrapes no placeholder SLO series.
+        self._c_rejected = m.counter(
+            "serve_requests_rejected_total",
+            "Requests rejected at submit, by reason.",
+            labelnames=("reason",))
+        self._c_shed = m.counter(
+            "serve_requests_shed_total",
+            "Queued requests shed after their deadline expired.")
+        self.slo = SLOLedger(m)
+        self.flight = flight if flight is not None else FlightRecorder()
+        self.watchdog = WatchdogPanel(self, dump_dir=watchdog_dir,
+                                      enabled=watchdogs)
         m.add_collector(self._collect_metrics)
         self._rate_ring: deque = deque(maxlen=256)   # (t, tokens read back)
         # On-demand jax.profiler window (POST /profile): requested from
@@ -661,6 +713,9 @@ class Engine:
         self._c_tokens._set_total(self.tokens_generated)
         self._c_steps._set_total(self.steps)
         self._c_admitted._set_total(self.admitted)
+        self._c_shed._set_total(self.shed)
+        for reason, n in list(self.rejected.items()):
+            self._c_rejected.labels(reason=reason)._set_total(n)
         self._g_active.set(len(self._active))
         self._g_free.set(self.sched.free_slots)
         self._g_queued.set(self.sched.queued)
@@ -678,61 +733,106 @@ class Engine:
         for name, n in self.tracecheck.counts().items():
             self._c_traces.labels(program=name)._set_total(n)
 
+    def _reject(self, reason: str, msg: str, **fields) -> None:
+        """Reject a submission: count it, leave the terminal ``reject``
+        event in the flight ledger (rid None — no id was ever assigned,
+        matching the error the caller gets), raise the client error."""
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        self.flight.record("reject", step=self.steps, reason=reason,
+                           **fields)
+        raise ValueError(msg)
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               seed: int = 0, eos_id: Optional[int] = None) -> int:
+               seed: int = 0, eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               slo_class: str = "default") -> int:
         """Queue one request; returns its id. Fixed-shape admission rules
         are enforced here so a bad request fails at submit, not as a
-        mid-flight surprise."""
+        mid-flight surprise — every reject leaves a terminal ``reject``
+        event in the flight ledger. ``deadline_s`` (default: the
+        engine's default_deadline_s) arms SLO accounting and queue-time
+        shedding; ``slo_class`` labels it on /metrics."""
         prompt = tuple(int(t) for t in prompt)
+        plen = len(prompt)
         if not prompt:
-            raise ValueError("empty prompt (encode at least one token)")
+            self._reject("empty_prompt",
+                         "empty prompt (encode at least one token)")
         if max_new_tokens < 0:
-            raise ValueError(
-                f"max_new_tokens must be >= 0, got {max_new_tokens}")
-        if len(prompt) > self.sched.buckets[-1]:
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest prefill "
-                f"bucket {self.sched.buckets[-1]}")
-        total = len(prompt) + max_new_tokens
+            self._reject(
+                "bad_max_new",
+                f"max_new_tokens must be >= 0, got {max_new_tokens}",
+                prompt_len=plen)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        else:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                self._reject("bad_deadline",
+                             f"deadline_s must be > 0, got {deadline_s}",
+                             prompt_len=plen)
+        try:
+            slo_class = validate_slo_class(str(slo_class))
+        except ValueError as e:
+            self._reject("bad_slo_class", str(e), prompt_len=plen)
+        if plen > self.sched.buckets[-1]:
+            self._reject(
+                "prompt_exceeds_bucket",
+                f"prompt length {plen} exceeds the largest prefill "
+                f"bucket {self.sched.buckets[-1]}", prompt_len=plen)
+        total = plen + max_new_tokens
         if total > self.max_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
+            self._reject(
+                "exceeds_max_len",
+                f"prompt ({plen}) + max_new_tokens "
                 f"({max_new_tokens}) = {total} exceeds the per-slot KV "
                 f"length {self.max_len}; long-context decode belongs to "
-                "sample.py's windowed path")
+                "sample.py's windowed path", prompt_len=plen)
         if self.paged:
             # The no-deadlock split: a request the POOL could never hold
             # (even with every block free) is rejected HERE, loudly; one
             # that merely cannot fit RIGHT NOW queues and admission
             # defers it until running requests release blocks — full
             # reservation at admit means nothing mid-decode ever waits.
-            need = self.block_pool.blocks_needed(len(prompt),
-                                                 max_new_tokens)
+            need = self.block_pool.blocks_needed(plen, max_new_tokens)
             if need > self.kv_pool_blocks:
-                raise ValueError(
+                self._reject(
+                    "pool_too_small",
                     f"request needs {need} KV blocks but the pool holds "
                     f"{self.kv_pool_blocks}; raise kv_pool_blocks or "
-                    "shorten the request")
+                    "shorten the request", prompt_len=plen)
         rid = next(self._rid)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=float(temperature), top_k=int(top_k),
-                      top_p=float(top_p), seed=int(seed), eos_id=eos_id)
+                      top_p=float(top_p), seed=int(seed), eos_id=eos_id,
+                      deadline_s=deadline_s, slo_class=slo_class)
         self._c_submitted.inc()
+        sub_fields = {"prompt_len": plen, "max_new": max_new_tokens,
+                      "slo_class": slo_class}
+        if deadline_s is not None:
+            sub_fields["deadline_s"] = deadline_s
+        self.flight.record("submit", rid=rid, step=self.steps,
+                           **sub_fields)
         if max_new_tokens == 0:
             # Counts as completed too (never reaches _finish): the
             # natural submitted-minus-completed in-flight alert must
             # not drift on zero-token requests.
             self._c_completed.labels(reason="length").inc()
+            self.flight.record("finish", rid=rid, step=self.steps,
+                               reason="length", tokens=0, e2e_s=0.0)
+            self.slo.record_finish(slo_class, tokens=0, elapsed_s=0.0,
+                                   deadline_s=deadline_s)
             self._pending_results.append(
                 Result(rid=rid, prompt=prompt, tokens=[],
                        finish_reason="length"))
             return rid
         sid = self.tracer.begin("queued", cat="request", rid=rid,
-                                args={"prompt_len": len(prompt),
+                                args={"prompt_len": plen,
                                       "max_new": max_new_tokens})
         self._submit_meta[rid] = (self.steps, time.monotonic(), sid)
         self.sched.enqueue(req)
+        self.flight.record("queue", rid=rid, step=self.steps,
+                           depth=self.sched.queued)
         return rid
 
     def has_work(self) -> bool:
@@ -748,11 +848,16 @@ class Engine:
         self._profile_window_start()
         finished = self._step_impl()
         self._profile_window_advance()
+        self.watchdog.check()
         return finished
 
     def _step_impl(self) -> List[Result]:
         finished, self._pending_results = self._pending_results, []
 
+        # Shed queued requests whose deadline already passed — BEFORE
+        # admission, so an expired request never eats a slot, a prefill
+        # program, or KV blocks on its way to a missed SLO.
+        self._shed_expired(finished)
         # Backfill free slots mid-flight; a wave finishing on its prefill
         # tokens immediately frees slots for the next wave in line.
         self._admit_waves(finished)
@@ -803,6 +908,34 @@ class Engine:
             # same one-step lag as the synchronous loop instead of two.
             self._admit_waves(finished)
         return finished
+
+    def _shed_expired(self, finished: List[Result]) -> None:
+        """Drop queued requests whose deadline expired while waiting:
+        terminal ``shed`` Result (empty tokens), counted against SLO
+        attainment. Requests without deadlines never shed. Cheap when
+        the queue carries no deadlines — one attribute scan, no
+        allocation (scheduler.drain_expired)."""
+        if not self.sched.queued:
+            return
+        now = time.monotonic()
+        meta = self._submit_meta
+
+        def expired(req) -> bool:
+            return (req.deadline_s is not None
+                    and now - meta[req.rid][1] > req.deadline_s)
+
+        for req in self.sched.drain_expired(expired):
+            sub_step, sub_t, sid = meta.pop(req.rid)
+            self.shed += 1
+            self.tracer.end(sid, {"shed": True,
+                                  "wait_steps": self.steps - sub_step})
+            self.flight.record("shed", rid=req.rid, step=self.steps,
+                               waited_s=round(now - sub_t, 6),
+                               deadline_s=req.deadline_s,
+                               slo_class=req.slo_class)
+            self.slo.record_shed(req.slo_class)
+            finished.append(Result(rid=req.rid, prompt=req.prompt,
+                                   tokens=[], finish_reason="shed"))
 
     def drain(self) -> List[Result]:
         """Run step() until queue, slots and pipeline are empty."""
@@ -946,10 +1079,15 @@ class Engine:
         paged_stats: dict = {"enabled": self.paged}
         if self.block_pool is not None:
             paged_stats.update(self.block_pool.stats())
-            paged_stats["ttft_hit_s"] = self._ttft_prefix.labels(
-                prefix="hit").percentiles((50, 90, 99))
-            paged_stats["ttft_miss_s"] = self._ttft_prefix.labels(
-                prefix="miss").percentiles((50, 90, 99))
+            # peek, never labels(): reading stats must not mint empty
+            # {prefix=} series for the exposition to render (hygiene).
+            hit = self._ttft_prefix.peek(prefix="hit")
+            miss = self._ttft_prefix.peek(prefix="miss")
+            paged_stats["ttft_hit_s"] = (
+                hit.percentiles((50, 90, 99)) if hit is not None else None)
+            paged_stats["ttft_miss_s"] = (
+                miss.percentiles((50, 90, 99)) if miss is not None
+                else None)
         return {
             "num_slots": self.num_slots,
             "max_len": self.max_len,
@@ -967,6 +1105,12 @@ class Engine:
             "free_slots": self.sched.free_slots,
             "admitted": self.admitted,
             "completed": self.completed,
+            "shed": self.shed,
+            "rejected": dict(self.rejected),
+            "default_deadline_s": self.default_deadline_s,
+            "slo": self.slo.stats(),
+            "flight": self.flight.stats(),
+            "watchdog": self.watchdog.stats(),
             "decode_steps": self.steps,
             "tokens_generated": self.tokens_generated,
             "decode_tokens_per_sec": self._recent_rate(),
@@ -1075,6 +1219,93 @@ class Engine:
         return self.tracecheck.counts()
 
     # ------------------------------------------------------------------
+    # live introspection (GET /debug/slots | /debug/kvpool |
+    # /debug/scheduler). Best-effort reads from an HTTP handler thread
+    # while the loop thread mutates — same discipline as /stats: every
+    # shared structure is snapshotted (list()/get()) before iteration,
+    # and a torn read across two fields yields a stale view, never a
+    # crash. No device state is touched (host dicts and plain ints).
+    # ------------------------------------------------------------------
+    def debug_slots(self) -> dict:
+        """Per-slot occupancy: who owns each row, how far along it is,
+        and how stale its last token is (the stuck-slot watchdog's view,
+        on demand)."""
+        now = time.monotonic()
+        inflight = dict(self._inflight[1]) if self._inflight is not None \
+            else {}
+        active = dict(self._active)
+        slots = []
+        for slot in range(self.num_slots):
+            st = active.get(slot)
+            if st is None:
+                slots.append({"slot": slot, "state": "free"})
+                continue
+            req = st.req
+            slots.append({
+                "slot": slot, "state": "active", "rid": req.rid,
+                "slo_class": req.slo_class, "deadline_s": req.deadline_s,
+                "prompt_len": len(req.prompt),
+                "max_new": req.max_new_tokens,
+                "tokens": len(st.tokens),
+                "age_s": round(now - st.submit_t, 6),
+                "since_last_token_s": round(now - st.last_t, 6),
+                "prefix_hit": bool(st.alloc.n_hit)
+                if st.alloc is not None else False,
+                "in_flight_step": inflight.get(slot) == req.rid,
+                "spec_accepted": st.spec_accepted,
+            })
+        return {"num_slots": self.num_slots, "active": len(active),
+                "free_slots": self.sched.free_slots, "slots": slots}
+
+    def debug_kvpool(self) -> dict:
+        """Paged-pool block states, fragmentation and radix-trie
+        occupancy (serve/paged.py debug view); {"paged": False} on a
+        dense engine."""
+        if self.block_pool is None:
+            return {"paged": False}
+        live = [(st.req.rid, len(st.req.prompt) + len(st.tokens), st.alloc)
+                for st in list(self._active.values())
+                if st.alloc is not None]
+        return {"paged": True, "kv_page_size": self.kv_page_size,
+                **self.block_pool.debug(live)}
+
+    def debug_scheduler(self) -> dict:
+        """Queue composition head-first — per-request wait, deadline
+        state (the shed forecast), bucket — plus the admission ladders
+        and, under spec, the drafter's live acceptance."""
+        now = time.monotonic()
+        queued = []
+        for item in self.sched.queued_items():
+            meta = self._submit_meta.get(item.rid)
+            waited = None if meta is None else round(now - meta[1], 6)
+            queued.append({
+                "rid": item.rid, "prompt_len": len(item.prompt),
+                "max_new": item.max_new_tokens,
+                # The no-hit bucket (bucket_for, not _suffix_bucket): a
+                # debug read must not walk the radix trie the loop
+                # thread owns, nor touch its LRU clocks.
+                "bucket": self.sched.bucket_for(len(item.prompt)),
+                "slo_class": item.slo_class,
+                "deadline_s": item.deadline_s,
+                "waited_s": waited,
+                "expired": bool(item.deadline_s is not None
+                                and waited is not None
+                                and waited > item.deadline_s),
+            })
+        out = {"queued": len(queued), "queue": queued,
+               "free_slots": self.sched.free_slots,
+               "active": len(self._active),
+               "prefill_buckets": list(self.sched.buckets),
+               "admit_buckets": list(self.admit_buckets),
+               "pipeline": self.pipeline,
+               "inflight_step": self._inflight is not None,
+               "steps": self.steps, "shed": self.shed,
+               "default_deadline_s": self.default_deadline_s}
+        if self._spec is not None:
+            out["spec"] = self._spec.debug()
+        return out
+
+    # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
     def _suffix_bucket(self, req) -> int:
@@ -1097,8 +1328,17 @@ class Engine:
                     a = self.block_pool.admit(req.prompt,
                                               req.max_new_tokens)
                     if a is None:
+                        self.flight.record(
+                            "block_stall", rid=req.rid, step=self.steps,
+                            need=self.block_pool.blocks_needed(
+                                len(req.prompt), req.max_new_tokens),
+                            free=self.block_pool.free_blocks)
                         return False
                     allocs.append(a)
+                    self.flight.record("block_reserve", rid=req.rid,
+                                       step=self.steps,
+                                       blocks=len(a.table),
+                                       hit_blocks=a.n_hit)
                     return True
 
                 wave = self.sched.next_admission_wave(
@@ -1171,18 +1411,34 @@ class Engine:
                 sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
                 self._queue_wait.observe(self.steps - sub_step)
                 self._ttft.observe(now - sub_t)
+                self.watchdog.on_ttft(now - sub_t)
                 alloc = allocs[i] if self.paged else None
-                if self.paged:
+                hit_toks = (alloc.n_hit * self.kv_page_size
+                            if alloc is not None else 0)
+                if self.paged and self.block_pool.cache is not None:
+                    # The by-prefix-outcome TTFT split exists only when
+                    # the prefix cache does — a cache-less engine must
+                    # not mint placeholder {prefix=} series (the
+                    # /metrics label-hygiene rule).
                     self._ttft_prefix.labels(
-                        prefix="hit" if alloc.n_hit else "miss").observe(
+                        prefix="hit" if hit_toks else "miss").observe(
                             now - sub_t)
                 self.tracer.end(queued_sid,
                                 {"wait_steps": self.steps - sub_step})
+                self.flight.record("admit", rid=req.rid, step=self.steps,
+                                   slot=slot, bucket=bucket, rung=k,
+                                   wait_steps=self.steps - sub_step)
+                self.flight.record(
+                    "prefill", rid=req.rid, step=self.steps,
+                    prefix="hit" if hit_toks else "miss",
+                    hit_tokens=hit_toks,
+                    suffix_tokens=len(req.prompt) - hit_toks)
                 gen_sid = self.tracer.begin(
                     "generate", cat="request", rid=req.rid,
                     args={"slot": slot, "bucket": bucket})
                 st = _Active(req=req, slot=slot,
                              tokens=[int(toks_host[i])], first_token_t=now,
+                             submit_t=sub_t, last_t=now,
                              span=gen_sid, alloc=alloc)
                 self._active[slot] = st
                 done = self._maybe_finish(st)
@@ -1261,6 +1517,9 @@ class Engine:
                 # pipelined ride-along drop.
                 toks = toks[:toks.index(st.req.eos_id) + 1]
             st.tokens.extend(toks)
+            st.last_t = now
+            self.flight.record("retire", rid=st.req.rid, step=self.steps,
+                               n=len(toks), accepted=acc)
             n_kept += len(toks)
             done = self._maybe_finish(st)
             if done is not None:
@@ -1304,7 +1563,12 @@ class Engine:
             if st is None or st.req.rid != rid:
                 continue
             st.tokens.append(int(nxt[slot]))
+            st.last_t = now
             n_live += 1
+            # One flight event per retired token per row — the ledger's
+            # finest grain ("why did rid X stall at token 40"); recorded
+            # from the just-read-back host array, never a device value.
+            self.flight.record("retire", rid=rid, step=self.steps, n=1)
             done = self._maybe_finish(st)
             if done is not None:
                 finished.append(done)
@@ -1339,6 +1603,12 @@ class Engine:
         self._spec_accept_len.reset()
         self._spec_req_accepted.reset()
         self.tracer.clear()
+        # The SLO ledger, flight ring and the watchdog's TTFT baseline
+        # describe the measured traffic too — warmup requests are
+        # synthetic, deadline-less, and compile-time slow.
+        self.slo.reset()
+        self.flight.clear()
+        self.watchdog.reset()
         if self.block_pool is not None:
             # Hit rates and capacity means should describe the measured
             # workload too — warmup prompts are synthetic and all-miss.
@@ -1376,8 +1646,11 @@ class Engine:
             reason = "length"
         if reason is None:
             return None
+        now = time.monotonic()
         del self._active[state.slot]
         self.sched.release(state.slot)
+        self.flight.record("evict", rid=req.rid, step=self.steps,
+                           slot=state.slot)
         # Park the idle row on device; queued after any in-flight step,
         # so the ride-along step (if one is in flight) still reads the
         # pre-release state it was dispatched with.
@@ -1396,10 +1669,25 @@ class Engine:
         self._c_completed.labels(reason=reason).inc()
         self.tracer.end(state.span, {"tokens": len(state.tokens),
                                      "finish_reason": reason})
+        # SLO + flight terminal: end-to-end latency vs deadline, tokens
+        # into the goodput ledger, the exactly-once `finish` event.
+        elapsed = now - state.submit_t
+        prefix = ("hit" if state.alloc is not None and state.alloc.n_hit
+                  else "miss")
+        met = self.slo.record_finish(req.slo_class,
+                                     tokens=len(state.tokens),
+                                     elapsed_s=elapsed,
+                                     deadline_s=req.deadline_s,
+                                     prefix=prefix)
+        fin = {"reason": reason, "tokens": len(state.tokens),
+               "e2e_s": round(elapsed, 6)}
+        if met is not None:
+            fin["deadline_met"] = met
+        self.flight.record("finish", rid=req.rid, step=self.steps, **fin)
         if self._spec is not None:
             self._spec_req_accepted.observe(state.spec_accepted)
         if len(state.tokens) > 1:
-            self._tpot.observe((time.monotonic() - state.first_token_t)
+            self._tpot.observe((now - state.first_token_t)
                                / (len(state.tokens) - 1))
         return Result(rid=req.rid, prompt=req.prompt, tokens=state.tokens,
                       finish_reason=reason)

@@ -143,8 +143,11 @@ def make_server(host: str, port: int, loop: EngineLoop,
 
     POST /generate  {"prompt": str | "prompt_tokens": [int], and any of
                      max_new_tokens, temperature, top_k, top_p, seed,
-                     eos_id}  ->  {"id", "tokens", "text",
-                     "finish_reason"}
+                     eos_id, deadline_s, slo_class}  ->  {"id",
+                     "tokens", "text", "finish_reason"}. deadline_s
+                     arms SLO accounting + queue-time shedding; a shed
+                     request returns finish_reason "shed" with empty
+                     tokens.
     GET  /healthz   -> {"ok": true}
     GET  /stats     -> engine counters (slots, queue, compiles) plus the
                      latency signal (decode_tokens_per_sec,
@@ -163,6 +166,15 @@ def make_server(host: str, port: int, loop: EngineLoop,
                      next N engine steps; responds immediately with the
                      trace dir ({"dir", "steps"}), completion shows up
                      in /stats under "profile"
+    GET  /debug/requests  flight-recorder lifecycle events.
+                     ?rid=N: one request's track (404 unknown);
+                     ?last_s=S: trailing window; ?format=jsonl: NDJSON
+                     dump instead of the {"events": [...]} JSON view
+    GET  /debug/slots     per-slot occupancy (rid, progress, staleness)
+    GET  /debug/kvpool    paged-pool block states + fragmentation +
+                     radix-trie occupancy ({"paged": false} on dense)
+    GET  /debug/scheduler queue composition (per-request wait/deadline/
+                     bucket), ladders, shed count, spec acceptance
     """
 
     # Loop in-flight accounting as gauges, collected per scrape — the
@@ -244,6 +256,34 @@ def make_server(host: str, port: int, loop: EngineLoop,
                                               "out of the span ring)"})
                     return
                 self._json(200, trace)
+            elif url.path == "/debug/requests":
+                try:
+                    q = urllib.parse.parse_qs(url.query)
+                    rid = int(q["rid"][0]) if "rid" in q else None
+                    last_s = (float(q["last_s"][0])
+                              if "last_s" in q else None)
+                    fmt = q.get("format", ["json"])[0]
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": f"bad query: {e!r}"})
+                    return
+                flight = loop.engine.flight
+                if rid is not None and not flight.events(rid=rid):
+                    self._json(404, {"error": f"no flight events for rid "
+                                              f"{rid} (unknown id, or "
+                                              "rotated out of the ring)"})
+                    return
+                if fmt == "jsonl":
+                    self._text(200, flight.to_jsonl(rid=rid, last_s=last_s),
+                               "application/x-ndjson")
+                else:
+                    self._json(200, {"events": flight.events(
+                        rid=rid, last_s=last_s)})
+            elif url.path == "/debug/slots":
+                self._json(200, loop.engine.debug_slots())
+            elif url.path == "/debug/kvpool":
+                self._json(200, loop.engine.debug_kvpool())
+            elif url.path == "/debug/scheduler":
+                self._json(200, loop.engine.debug_scheduler())
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -297,6 +337,10 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 )
                 if payload.get("eos_id") is not None:
                     kwargs["eos_id"] = int(payload["eos_id"])
+                if payload.get("deadline_s") is not None:
+                    kwargs["deadline_s"] = float(payload["deadline_s"])
+                if payload.get("slo_class") is not None:
+                    kwargs["slo_class"] = str(payload["slo_class"])
             except (ValueError, TypeError, KeyError,
                     json.JSONDecodeError) as e:
                 # KeyError: a char tokenizer raises it for prompt chars

@@ -230,6 +230,10 @@ class BlockPool:
     def blocks_needed(self, prompt_len: int, max_new: int) -> int:
         return blocks_for(prompt_len + max_new, self.page)
 
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
     def _match(self, prompt: Sequence[int]) -> List[_Node]:
         key = tuple(prompt)
         if self._match_memo is not None and self._match_memo[0] == key:
@@ -361,6 +365,63 @@ class BlockPool:
                 self.private_blocks_allocated / self.requests
                 if self.requests else None),
         }
+
+    def debug(self, live: Sequence[tuple] = ()) -> dict:
+        """The GET /debug/kvpool view: block-state partition,
+        fragmentation, trie occupancy, and the per-request block story.
+
+        ``live`` is the engine's [(rid, used_positions, Allocation)]
+        snapshot — the pool tracks block IDS, only the engine knows how
+        many positions each reservation has actually written, which is
+        what internal fragmentation is about: full reservation at
+        admission trades elasticity for positions reserved-but-unwritten
+        until the request's generation catches up."""
+        out = self.stats()
+        reserved_pos = used_pos = 0
+        per_request = []
+        for rid, used, alloc in live:
+            reserved = len(alloc.table) * self.page
+            reserved_pos += reserved
+            used_pos += min(used, reserved)
+            per_request.append({
+                "rid": rid, "blocks": len(alloc.table),
+                "hit_blocks": alloc.n_hit,
+                "reserved_positions": reserved,
+                "used_positions": min(used, reserved),
+            })
+        out["fragmentation"] = {
+            # reserved-but-unwritten fraction of live reservations (the
+            # full-reservation contract's cost, shrinking as requests
+            # decode into their budgets)...
+            "internal": (1.0 - used_pos / reserved_pos
+                         if reserved_pos else 0.0),
+            "reserved_positions": reserved_pos,
+            "used_positions": used_pos,
+            # ...and the pool-level free fraction (paged pools never
+            # fragment externally — any free block serves any request).
+            "free_frac": len(self._free) / self.num_blocks,
+        }
+        trie: dict = {"enabled": self.cache is not None}
+        if self.cache is not None:
+            depths: Dict[int, int] = {}
+            for n in self.cache._nodes:
+                d = 0
+                p = n.parent
+                while p is not None:
+                    d += 1
+                    p = p.parent
+                depths[d] = depths.get(d, 0) + 1
+            trie.update({
+                "nodes": len(self.cache),
+                "cached_tokens": len(self.cache) * self.page,
+                "evictable_blocks": self.cache.evictable(),
+                "depth_histogram": {str(k): v
+                                    for k, v in sorted(depths.items())},
+                "max_depth": max(depths) if depths else 0,
+            })
+        out["trie"] = trie
+        out["live_requests"] = per_request
+        return out
 
     def check(self, live_allocs: Sequence[Allocation] = ()) -> None:
         """Invariant audit (tests call this after every fuzz step): the

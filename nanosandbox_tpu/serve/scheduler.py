@@ -165,6 +165,28 @@ class SlotScheduler:
             return None
         return items, slots, bucket
 
+    def drain_expired(self, expired) -> List:
+        """Remove and return every queued item for which ``expired(item)``
+        is true, preserving FIFO order of the survivors — the engine's
+        deadline shed: a request whose deadline passed while it waited
+        is dropped from the queue (with a terminal ``shed`` outcome)
+        instead of burning slots on an answer its client stopped
+        waiting for. Cheap when nothing expired: the scan is attribute
+        checks only and the queue is rebuilt only on a hit."""
+        if not any(expired(item) for item in self._queue):
+            return []
+        shed: List = []
+        kept: Deque = deque()
+        for item in self._queue:
+            (shed if expired(item) else kept).append(item)
+        self._queue = kept
+        return shed
+
+    def queued_items(self) -> List:
+        """Snapshot of the queue, head first (the /debug/scheduler
+        view; callers must not mutate the items)."""
+        return list(self._queue)
+
     def release(self, slot: int) -> None:
         if slot in self._free:
             raise ValueError(f"slot {slot} released twice")

@@ -288,3 +288,12 @@ class SpecRunner:
             "tokens_accepted": self.accepted,
             "acceptance_rate": rate,
         }
+
+    def debug(self) -> dict:
+        """The GET /debug/scheduler "spec" block: whether the verify
+        step is earning its k, from already-host-resident ints — the
+        live counterpart of the bench acceptance numbers."""
+        return {**self.stats(),
+                "drafter_kind": self.drafter.kind,
+                "mean_accepted_per_verify": (self.accepted / self.steps
+                                             if self.steps else None)}

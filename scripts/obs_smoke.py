@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """CI smoke for the telemetry spine: start the serve HTTP frontend,
 scrape /metrics, validate the Prometheus exposition with a stdlib
-parser, fetch a /trace export and check its Chrome trace-event schema.
+parser, fetch a /trace export and check its Chrome trace-event schema,
+hit the /debug introspection surface (requests / slots / kvpool /
+scheduler) and schema-validate a flight-recorder JSONL dump.
 
 Runs the REAL frontend (EngineLoop + make_server) over a tiny randomly
 initialized model — the wiring under test is the observability surface,
@@ -50,6 +52,23 @@ def validate_exposition(text: str) -> dict[str, str]:
     return types
 
 
+def validate_flight_jsonl(text: str) -> list[dict]:
+    """Schema-validate a flight-recorder JSONL dump: every line is one
+    JSON object carrying the event keys the playbook documents."""
+    events = []
+    for ln in text.splitlines():
+        e = json.loads(ln)
+        assert isinstance(e, dict), e
+        assert {"t", "ev", "rid", "wall"} <= set(e), e
+        assert isinstance(e["ev"], str) and e["ev"]
+        assert e["rid"] is None or isinstance(e["rid"], int)
+        assert isinstance(e["t"], (int, float)) and e["t"] >= 0
+        assert isinstance(e["wall"], (int, float))
+        events.append(e)
+    assert events, "empty flight dump"
+    return events
+
+
 def validate_chrome_trace(trace: dict) -> None:
     assert set(trace) >= {"traceEvents"}, trace.keys()
     events = trace["traceEvents"]
@@ -91,6 +110,10 @@ def main() -> int:
         load_budget(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "budgets", "serve_cpu8.json")),
         global_registry())
+    # Host-health gauges the deployment registers at startup.
+    from nanosandbox_tpu.obs import register_process_vitals
+
+    register_process_vitals()
     loop = EngineLoop(engine)
     loop.start()
     encode = lambda s: [min(ord(c), cfg.vocab_size - 1) for c in s]  # noqa: E731
@@ -109,7 +132,8 @@ def main() -> int:
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/generate",
             data=json.dumps({"prompt": "hello", "max_new_tokens": 8,
-                             "temperature": 0.0}).encode())
+                             "temperature": 0.0, "deadline_s": 60.0,
+                             "slo_class": "interactive"}).encode())
         with urllib.request.urlopen(req, timeout=60) as r:
             gen = json.loads(r.read())
         assert len(gen["tokens"]) == 8, gen
@@ -120,8 +144,18 @@ def main() -> int:
         for required in ("serve_ttft_seconds", "serve_tpot_seconds",
                          "serve_decode_tokens_per_sec",
                          "serve_queue_depth", "serve_tokens_generated_total",
-                         "serve_compile_traces_total"):
+                         "serve_compile_traces_total",
+                         # host vitals (ISSUE 10 satellite)
+                         "process_resident_memory_bytes",
+                         "process_open_fds", "process_uptime_seconds",
+                         "jax_live_buffer_bytes",
+                         # SLO ledger: the deadline-carrying request above
+                         "serve_slo_requests_total",
+                         "serve_goodput_tokens_total",
+                         "serve_slo_attainment"):
             assert required in types, (required, sorted(types))
+        assert 'serve_slo_requests_total{slo_class="interactive",' \
+            'outcome="met"} 1' in text, "SLO outcome missing from scrape"
         assert types["serve_ttft_seconds"] == "histogram"
         assert "serve_ttft_seconds_window" in types  # percentile summary
         # The pinned comms contract is on the scrape: every serve
@@ -138,10 +172,39 @@ def main() -> int:
         window = json.loads(get("/trace?last_s=600"))
         validate_chrome_trace(window)
 
+        # Flight-recorder surface (ISSUE 10): the rid's lifecycle track
+        # as JSON, the JSONL dump schema-validated, and a terminal
+        # `finish` exactly once.
+        track = json.loads(get(f"/debug/requests?rid={rid}"))["events"]
+        evs = [e["ev"] for e in track]
+        assert evs[0] == "submit" and evs[-1] == "finish", evs
+        assert "admit" in evs and "prefill" in evs, evs
+        assert evs.count("finish") == 1, evs
+        flight = validate_flight_jsonl(
+            get("/debug/requests?format=jsonl").decode())
+        assert any(e["ev"] == "finish" and e["rid"] == rid
+                   for e in flight), "rid's finish missing from dump"
+
+        # Live introspection endpoints.
+        slots = json.loads(get("/debug/slots"))
+        assert slots["num_slots"] == 4, slots
+        assert len(slots["slots"]) == 4
+        pool = json.loads(get("/debug/kvpool"))
+        assert pool["paged"] is True, pool
+        assert {"free", "live", "cached", "fragmentation",
+                "trie"} <= set(pool), sorted(pool)
+        assert pool["free"] + pool["live"] + pool["cached"] \
+            == pool["num_blocks"], pool
+        sched = json.loads(get("/debug/scheduler"))
+        assert {"queue", "free_slots", "prefill_buckets",
+                "shed"} <= set(sched), sorted(sched)
+
         health = json.loads(get("/healthz"))
         assert health == {"ok": True}, health
         print(f"obs smoke OK: {len(types)} metric families, "
-              f"{len(trace['traceEvents'])} trace events for rid {rid}")
+              f"{len(trace['traceEvents'])} trace events and "
+              f"{len(track)} flight events for rid {rid}, "
+              f"{len(flight)} flight events dumped")
         return 0
     finally:
         srv.shutdown()

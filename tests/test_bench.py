@@ -48,3 +48,36 @@ def test_measure_train_throughput_rejects_zero_warmup(tiny_cfg):
     before t0); now it fails loudly at the API boundary."""
     with pytest.raises(ValueError, match="warmup"):
         measure_train_throughput(tiny_cfg, 0, 1)
+
+
+def test_bench_serve_mode_overload_sweep():
+    """--mode=serve contract (ISSUE 10): every sweep point carries
+    goodput_toks / slo_attainment / shed_rate, a 1x and a 2x arrival
+    point exist, the burst point actually sheds, and every shed Result
+    has exactly one terminal `shed` flight event (the ledger cross-check
+    is computed inside bench_serve from the same engine)."""
+    import jax  # noqa: F401  (engine import path needs a jax process)
+
+    result = bench.bench_serve(
+        {"num_slots": "4", "requests": "8", "burst": "6"},
+        quick=True, on_tpu=False)
+    extra = result["extra"]
+    assert result["unit"] == "tokens/sec" and result["value"] >= 0
+    assert extra["capacity_toks_per_sec"] > 0
+    sweep = extra["sweep"]
+    assert {"1x", "2x", "burst"} <= set(sweep)
+    for point in sweep.values():
+        for fld in ("goodput_toks", "goodput_toks_per_sec",
+                    "slo_attainment", "shed_rate", "flight_shed_events"):
+            assert fld in point, (point["scenario"], fld)
+        assert 0.0 <= point["shed_rate"] <= 1.0
+        assert point["slo_attainment"] is None or \
+            0.0 <= point["slo_attainment"] <= 1.0
+        # ledger agreement: shed Results == terminal shed flight events
+        assert point["flight_shed_events"] == point["shed"]
+    # the burst point is built to overload: sheds must actually happen,
+    # or the queue-expiry path is dead code
+    assert sweep["burst"]["shed"] > 0
+    assert sweep["burst"]["slo_attainment"] < 1.0
+    import json as _json
+    _json.dumps(result)              # the CI artifact must serialize

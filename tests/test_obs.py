@@ -422,16 +422,22 @@ def test_engine_telemetry_adds_no_host_syncs(served_model):
         before = tracecheck.sync_counts()
         eng = Engine(model, params, num_slots=2, max_len=64, **engine_kw)
         for i in range(4):
-            eng.submit([1 + i, 2], 5)
+            eng.submit([1 + i, 2], 5, deadline_s=30.0)
         eng.drain()
         eng.metrics.snapshot()
         eng.tracer.export_chrome()
+        eng.flight.to_jsonl()
+        eng.debug_slots(), eng.debug_kvpool(), eng.debug_scheduler()
         after = tracecheck.sync_counts()
         return {k: after[k] - before.get(k, 0) for k in after
                 if after[k] != before.get(k, 0)}
 
+    from nanosandbox_tpu.obs import FlightRecorder
+
     with_obs = sync_delta()
-    without = sync_delta(tracer=SpanTracer(enabled=False))
+    without = sync_delta(tracer=SpanTracer(enabled=False),
+                         flight=FlightRecorder(enabled=False),
+                         watchdogs=False)
     assert with_obs == without
 
 
@@ -646,6 +652,224 @@ def test_http_metrics_trace_profile_roundtrip(served_model):
             time.sleep(0.05)
         last = json.loads(get("/stats")[0])["profile"]["last"]
         assert last is not None and last["steps"] == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+# ------------------------------------------- label hygiene (ISSUE 10)
+
+def test_exposition_label_hygiene_features_off(served_model):
+    """A family registered for a feature that is OFF (or simply never
+    exercised) must emit NOTHING — no empty/placeholder series. Pinned
+    with the same stdlib parser a scrape implies: spec off => no
+    serve_spec_* histograms; prefix cache off => no
+    serve_prefix_ttft_seconds{prefix=}; no deadlines => no serve_slo_*
+    series. Reading stats() must not mint the children either."""
+    _, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 prefix_cache=False)
+    eng.submit([1, 2, 3], 4)
+    eng.drain()
+    eng.stats()                       # reads must not create series
+    text = eng.metrics.prometheus_text()
+    types = parse_exposition(text)
+    for absent in ("serve_spec_accept_len", "serve_spec_req_accepted_tokens",
+                   "serve_prefix_ttft_seconds", "serve_slo_requests_total",
+                   "serve_goodput_tokens_total", "serve_slo_attainment",
+                   "serve_deadline_margin_seconds",
+                   "serve_requests_rejected_total", "watchdog_trips_total"):
+        assert absent not in types, absent
+        assert absent not in text, absent
+    # the always-on families still render
+    assert "serve_ttft_seconds" in types
+    assert "serve_requests_shed_total" in types
+
+
+def test_exposition_label_hygiene_features_on(served_model):
+    """The same families DO render once the features record: a prefix
+    cache observing TTFTs, a deadline-carrying request, a reject."""
+    _, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    eng.submit([1, 2, 3], 4, deadline_s=30.0, slo_class="interactive")
+    eng.drain()
+    with pytest.raises(ValueError):
+        eng.submit([], 4)
+    types = parse_exposition(eng.metrics.prometheus_text())
+    for present in ("serve_prefix_ttft_seconds", "serve_slo_requests_total",
+                    "serve_goodput_tokens_total", "serve_slo_attainment",
+                    "serve_deadline_margin_seconds",
+                    "serve_requests_rejected_total"):
+        assert present in types, present
+    text = eng.metrics.prometheus_text()
+    assert 'serve_prefix_ttft_seconds_bucket{prefix="miss"' in text
+    assert 'serve_prefix_ttft_seconds_bucket{prefix="hit"' not in text
+    assert 'serve_requests_rejected_total{reason="empty_prompt"} 1' in text
+
+
+def test_family_reads_do_not_create_series():
+    from nanosandbox_tpu.obs import MetricRegistry as _MR
+
+    reg = _MR()
+    h = reg.histogram("h_seconds", "H.", labelnames=("k",))
+    g = reg.gauge("g_val", "G.")
+    c = reg.counter("c_total", "C.")
+    assert h.peek(k="x") is None
+    assert g.value is None and c.value is None
+    assert reg.prometheus_text() == ""
+    h.labels(k="x").observe(0.1)
+    assert h.peek(k="x").count == 1
+    assert "h_seconds" in reg.prometheus_text()
+
+
+# ------------------------------------------------------ process vitals
+
+def test_process_vitals_families(served_model):
+    from nanosandbox_tpu.obs import MetricRegistry as _MR
+    from nanosandbox_tpu.obs import register_process_vitals
+
+    reg = _MR()
+    assert register_process_vitals(reg) is reg
+    register_process_vitals(reg)      # idempotent: no duplicate collector
+    snap = reg.snapshot()
+    assert snap["process_resident_memory_bytes"]["series"][0]["value"] > 0
+    assert snap["process_uptime_seconds"]["series"][0]["value"] >= 0
+    assert snap["process_open_fds"]["series"][0]["value"] > 0
+    # jax is imported in this process, so live-buffer gauges are real
+    assert snap["jax_live_buffer_count"]["series"][0]["value"] > 0
+    assert snap["jax_live_buffer_bytes"]["series"][0]["value"] > 0
+    types = parse_exposition(reg.prometheus_text())
+    assert types["process_resident_memory_bytes"] == "gauge"
+
+
+# ------------------------------- /trace on the paged engine (ISSUE 10)
+
+def test_trace_prefix_hit_shows_smaller_prefill_wave(served_model):
+    """A prefix-hit request's admission wave prefills only the SUFFIX
+    bucket: its prefill_wave span must carry a strictly smaller bucket
+    than its cold twin's, and its queued->generate rid track stays
+    intact — the /trace evidence that the hit skipped prefill work."""
+    _, model, params = served_model
+    import numpy as np
+
+    base = np.random.default_rng(3).integers(0, 50, 40).tolist()
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    cold = eng.submit(base, 4)
+    eng.drain()
+    hot = eng.submit(base[:35] + [7, 8, 9], 4)
+    eng.drain()
+
+    def wave_for(rid):
+        waves = [s for s in eng.tracer.spans()
+                 if s.name == "prefill_wave" and rid in s.args["rids"]]
+        assert len(waves) == 1, (rid, waves)
+        return waves[0]
+
+    cold_wave, hot_wave = wave_for(cold), wave_for(hot)
+    assert cold_wave.args["bucket"] == 64          # full-prompt bucket
+    assert hot_wave.args["bucket"] < cold_wave.args["bucket"]
+    # the flight ledger tells the same story
+    pre = [e for e in eng.flight.events(rid=hot) if e["ev"] == "prefill"]
+    assert pre[0]["prefix"] == "hit" and pre[0]["hit_tokens"] == 32
+    # rid tracks intact in the chrome export
+    for rid in (cold, hot):
+        names = {ev["name"]
+                 for ev in eng.tracer.export_chrome(rid=rid)["traceEvents"]
+                 if ev["ph"] == "X" and ev["args"].get("rid") == rid}
+        assert {"queued", "generate"} <= names
+
+
+def test_pipelined_overlap_pin_holds_on_paged_engine(served_model):
+    """The PR 2 pipelined-overlap span pin, explicitly on paged=True
+    (and the sync engine's non-overlap twin): the block table rides the
+    same decode program, so pipelining must survive paging."""
+    _, model, params = served_model
+    for pipeline, want_overlap in ((True, True), (False, False)):
+        eng = Engine(model, params, num_slots=2, max_len=64,
+                     pipeline=pipeline, paged=True)
+        eng.submit([1, 2, 3], 12)
+        eng.drain()
+        steps = sorted((s for s in eng.tracer.spans()
+                        if s.name == "decode_step"),
+                       key=lambda s: s.args["step"])
+        assert len(steps) >= 4
+        overlaps = [a.t1 > b.t0 for a, b in zip(steps, steps[1:])]
+        assert all(overlaps) if want_overlap else not any(overlaps)
+
+
+# ----------------------------------------------- /debug HTTP endpoints
+
+def test_http_debug_endpoints_roundtrip(served_model):
+    """GET /debug/requests (JSON + JSONL + 404/400), /debug/slots,
+    /debug/kvpool, /debug/scheduler on the real frontend."""
+    from nanosandbox_tpu.serve.http import EngineLoop, make_server
+
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64)
+    loop = EngineLoop(eng)
+    loop.start()
+    encode = lambda s: [min(ord(c), cfg.vocab_size - 1) for c in s]  # noqa: E731
+    decode = lambda ids: " ".join(str(i) for i in ids)  # noqa: E731
+    srv = make_server("127.0.0.1", 0, loop, encode, decode)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=60) as r:
+            return r.read(), r.headers.get("Content-Type")
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    try:
+        gen = post("/generate", {"prompt": "hi", "max_new_tokens": 6,
+                                 "temperature": 0.0, "deadline_s": 60.0,
+                                 "slo_class": "interactive"})
+        rid = gen["id"]
+        assert gen["finish_reason"] == "length"
+
+        body, _ = get(f"/debug/requests?rid={rid}")
+        evs = json.loads(body)["events"]
+        assert [e["ev"] for e in evs][:2] == ["submit", "queue"]
+        assert evs[0]["slo_class"] == "interactive"
+        assert [e["ev"] for e in evs][-1] == "finish"
+
+        body, ctype = get(f"/debug/requests?rid={rid}&format=jsonl")
+        assert ctype == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in body.decode().splitlines()]
+        assert all({"t", "ev", "rid", "wall"} <= set(e) for e in lines)
+
+        body, _ = get("/debug/requests?last_s=600")
+        assert json.loads(body)["events"]
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/requests?rid=99999")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/requests?rid=junk")
+        assert ei.value.code == 400
+
+        slots = json.loads(get("/debug/slots")[0])
+        assert slots["num_slots"] == 4
+        assert {s["state"] for s in slots["slots"]} <= {"free", "active"}
+
+        pool = json.loads(get("/debug/kvpool")[0])
+        assert pool["paged"] is True and "fragmentation" in pool
+
+        sched = json.loads(get("/debug/scheduler")[0])
+        assert "queue" in sched and sched["free_slots"] == 4
+
+        # the SLO series from the deadline-carrying request are on the
+        # scrape, with real label values
+        text = get("/metrics")[0].decode()
+        assert 'serve_slo_requests_total{slo_class="interactive"' in text
+        assert "serve_goodput_tokens_total" in text
     finally:
         srv.shutdown()
         srv.server_close()
