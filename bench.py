@@ -20,13 +20,23 @@ Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
            [--emit_obs]
        python bench.py --mode=serve [--quick] [--num_slots=N] \
            [--requests=N] [--load=1,2] [--burst=6] \
-           [--interactive_share=F] [--emit_obs]
+           [--interactive_share=F] [--emit_obs] \
+           [--faults=chaos-smoke] [--flight_out=PATH]
 
 --mode=serve is the closed-loop load generator (Poisson arrivals at
 multiples of measured capacity, per-class deadlines, an all-at-once
 burst point): every sweep point emits goodput_toks, slo_attainment and
 shed_rate, turning goodput-under-overload into a regression-pinned
 number like tokens/sec.
+
+--faults=<plan> adds a CHAOS point to the serve sweep: the same 1x
+Poisson arrivals with a deterministic fault plan armed (serve/faults.py
+syntax, or a canned name like 'chaos-smoke') and the crash-safe
+supervisor driving recovery. The JSON gains extra.fault —
+goodput_under_fault_ratio (fault-point goodput / clean 1x), recovery
+counts/latency, time-to-first-retired-token — the numbers the CI chaos
+smoke pins. --flight_out dumps the fault run's flight-recorder JSONL
+for artifact upload.
 
 --emit_obs attaches the obs metric-registry snapshot (the same series a
 live /metrics scrape exposes) to the JSON under "obs".
@@ -680,8 +690,25 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     params = cast_params_for_serving(params, cfg.compute_dtype)
+    # --faults: attach a (disabled) fault plan + the recovery
+    # supervisor. The plan stays dark through warmup, the capacity
+    # probe and the clean sweep points — it re-arms (relative step 0 =
+    # now) only for the dedicated chaos point, so goodput-under-fault
+    # has a clean twin to be a ratio OF.
+    faults_spec = kv.get("faults")
+    fault_plan = None
+    if faults_spec:
+        from nanosandbox_tpu.serve import EngineSupervisor, FaultPlan
+        fault_plan = FaultPlan.parse(faults_spec)
+        fault_plan.enabled = False
     engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
-                    pipeline=True, paged=paged, kv_page_size=kv_page)
+                    pipeline=True, paged=paged, kv_page_size=kv_page,
+                    faults=fault_plan)
+    if fault_plan is not None:
+        stepper = EngineSupervisor(engine, backoff_base_s=0.01,
+                                   backoff_max_s=0.5)
+    else:
+        stepper = engine
 
     max_prompt = max(2, max_len - max_new)
     rng = np.random.default_rng(4242)
@@ -749,7 +776,7 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 engine.submit(prompt, mnt, deadline_s=dl, slo_class=cls)
                 i += 1
             if engine.has_work():
-                results.extend(engine.step())
+                results.extend(stepper.step())
             elif i < len(arrivals):
                 time.sleep(min(max(arrivals[i] - now, 0.0), 0.002))
         elapsed = time.perf_counter() - t0
@@ -799,6 +826,41 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         sweep["burst"]["arrival_multiplier"] = None
         sweep["burst"]["burst_size"] = n_burst
 
+    fault_extra = None
+    if fault_plan is not None:
+        # CHAOS point: the 1x arrival process again, with the plan
+        # armed relative to NOW — recovery happens mid-point and the
+        # point must still finish every request (run_point loops until
+        # the engine is idle, so an unrecovered engine hangs the bench
+        # rather than silently passing).
+        fault_plan.rearm(engine.steps)
+        fault_plan.enabled = True
+        gaps = rng.exponential(1.0 / req_rate_1x, n_requests)
+        sweep["fault"] = run_point("fault", np.cumsum(gaps).tolist())
+        fault_plan.enabled = False
+        if kv.get("flight_out"):
+            # The fault run's black box as a CI artifact: reset_latency
+            # at the point start cleared everything earlier, so this is
+            # exactly the chaos window's ledger.
+            engine.flight.dump(kv["flight_out"])
+        clean_1x = sweep.get("1x", {}).get("goodput_toks_per_sec")
+        under_fault = sweep["fault"]["goodput_toks_per_sec"]
+        rec = engine.stats()["recovery"]
+        sup_stats = stepper.stats()
+        fault_extra = {
+            "plan": fault_plan.describe(),
+            "fired": fault_plan.stats()["fired"],
+            "recoveries": engine.recoveries,
+            "requeued": engine.requeued,
+            "poisoned_steps": rec["poisoned_steps"],
+            "recovery_s": rec["recovery_s"],
+            "supervisor": sup_stats,
+            "supervisor_state": sup_stats["state"],
+            "goodput_under_fault_toks_per_sec": under_fault,
+            "goodput_under_fault_ratio": (
+                under_fault / clean_1x if clean_1x else None),
+        }
+
     one_x = sweep.get("1x") or next(iter(sweep.values()))
     from nanosandbox_tpu.analysis.shardcheck import provenance
 
@@ -830,6 +892,7 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "interactive_share": interactive_share,
             "req_per_s_1x": req_rate_1x,
             "sweep": sweep,
+            "fault": fault_extra,
             "watchdog_trips": engine.stats()["watchdog"]["trips"],
             "trace_counts": dict(engine.trace_counts),
         },

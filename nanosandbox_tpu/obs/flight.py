@@ -11,7 +11,13 @@ that gap (ISSUE 10):
 
         submit -> queue -> [block_stall*] -> block_reserve -> admit ->
         prefill[hit|miss] -> retire* -> evict -> finish
-                 (or terminal: reject at submit / shed from the queue)
+                 (or terminal: reject at submit / shed from the queue /
+                 failed on permanent-failure drain)
+
+    Under fault recovery (ISSUE 11) a track may additionally carry
+    fault / poison / quarantine / requeue / recover events — the
+    request re-enters at ``queue`` and STILL reaches exactly one
+    terminal (the fuzz pin covers interrupted-and-resumed requests).
 
     Each event is one small dict recorded from ALREADY-HOST-RESIDENT
     dispatch-time state (ints/floats the engine holds anyway), so the
@@ -44,8 +50,11 @@ from collections import deque
 from typing import Dict, List, Optional
 
 # Terminal lifecycle events: every submitted request must reach EXACTLY
-# one of these (the no-orphan contract tests fuzz against).
-TERMINAL_EVENTS = ("finish", "reject", "shed")
+# one of these (the no-orphan contract tests fuzz against, including
+# across engine recoveries — a fault-interrupted, re-admitted request
+# still terminates exactly once). 'failed' is the permanent-failure
+# drain (recovery exhausted; partial tokens salvaged).
+TERMINAL_EVENTS = ("finish", "reject", "shed", "failed")
 
 
 class FlightRecorder:
@@ -180,16 +189,25 @@ class WatchdogPanel:
       stuck_slot          an active slot with no retired token for
                           stuck_slot_s — a wedged device or a dead
                           pipeline, caught before the client timeout.
+      stalled_step        ONE engine step whose wall time exceeded
+                          stalled_step_s (fed by Engine.step's own
+                          clock) — a wedged dispatch/readback that DID
+                          eventually return; the recovery supervisor
+                          treats it (with stuck_slot) as recoverable.
 
     A trip increments ``watchdog_trips_total{kind=}`` on the engine's
     registry and (rate-limited per kind by ``cooldown_s``) snapshots
     the flight ledger, the span ring, and ``engine.stats()`` into
-    ``dump_dir/<kind>-<n>-<unixtime>/`` — flight.jsonl, trace.json,
-    meta.json.  Dump failures are recorded, never raised: the serving
-    loop outlives its black box."""
+    ``dump_dir/<kind>-<n>-<unixtime>/`` — flight-<kind>.jsonl,
+    trace-<kind>.json, meta-<kind>.json.  Dumps are SERIALIZED by a
+    lock and every file carries the trip kind: two trips of different
+    kinds racing (an HTTP-thread feed against the engine thread's
+    poll) can no longer interleave writes into one snapshot.  Dump
+    failures are recorded, never raised: the serving loop outlives its
+    black box."""
 
     KINDS = ("ttft_spike", "admission_stall", "pool_thrash",
-             "post_freeze_retrace", "stuck_slot")
+             "post_freeze_retrace", "stuck_slot", "stalled_step")
 
     def __init__(self, engine, *, dump_dir: Optional[str] = None,
                  enabled: bool = True,
@@ -201,7 +219,8 @@ class WatchdogPanel:
                  ttft_baseline_window: int = 128,
                  stall_trip_steps: int = 64,
                  thrash_factor: float = 1.0,
-                 stuck_slot_s: float = 120.0):
+                 stuck_slot_s: float = 120.0,
+                 stalled_step_s: float = 30.0):
         self.engine = engine
         self.enabled = enabled
         self.dump_dir = dump_dir
@@ -213,11 +232,15 @@ class WatchdogPanel:
         self.stall_trip_steps = stall_trip_steps
         self.thrash_factor = thrash_factor
         self.stuck_slot_s = stuck_slot_s
+        self.stalled_step_s = stalled_step_s
         self.trips: Dict[str, int] = {}
         self.last_trip: Optional[dict] = None
         self.dump_errors = 0
         self._ttft_ring: deque = deque(maxlen=ttft_baseline_window)
         self._last_dump: Dict[str, float] = {}
+        # Dumps serialize: concurrent trips of different kinds must not
+        # interleave their writes (regression-pinned).
+        self._dump_lock = threading.Lock()
         self._last_check_step = -1
         self._stall_mark = 0         # block_pool.stall_steps at last poll
         self._stall_polls = 0        # consecutive polls with stall growth
@@ -246,6 +269,17 @@ class WatchdogPanel:
                            {"ttft_s": ttft_s, "baseline_s": baseline,
                             "factor": ttft_s / baseline})
         ring.append(ttft_s)
+
+    def on_step_time(self, dt_s: float) -> None:
+        """Called by the engine with each step's wall time: one step
+        past ``stalled_step_s`` is a wedged dispatch (a hung device, a
+        runaway host stall), not load — load shows up as MANY normal
+        steps. One float compare when healthy."""
+        if not self.enabled:
+            return
+        if dt_s > self.stalled_step_s:
+            self._trip("stalled_step",
+                       {"step_s": dt_s, "limit_s": self.stalled_step_s})
 
     def mark_steady(self) -> None:
         """Declare the compile set complete (serve __main__ calls this
@@ -330,23 +364,35 @@ class WatchdogPanel:
     def _dump(self, kind: str, info: dict) -> Optional[str]:
         """Snapshot flight + spans + stats to the dump dir; returns the
         dump path, or None when writing failed (recorded, not raised —
-        a full disk must not kill the serving loop)."""
-        try:
-            if self.dump_dir is None:
-                self.dump_dir = tempfile.mkdtemp(prefix="serve-watchdog-")
-            d = os.path.join(self.dump_dir,
-                             f"{kind}-{self.trips[kind]}-{int(time.time())}")
-            os.makedirs(d, exist_ok=True)
-            self.engine.flight.dump(os.path.join(d, "flight.jsonl"))
-            with open(os.path.join(d, "trace.json"), "w") as f:
-                json.dump(self.engine.tracer.export_chrome(), f)
-            with open(os.path.join(d, "meta.json"), "w") as f:
-                json.dump({"trip": info, "trips": dict(self.trips),
-                           "stats": self.engine.stats()}, f, default=str)
-            return d
-        except OSError:
-            self.dump_errors += 1
-            return None
+        a full disk must not kill the serving loop).
+
+        Serialized under ``_dump_lock`` and every file is suffixed with
+        the trip kind: two near-simultaneous trips of DIFFERENT kinds
+        (e.g. an on_ttft feed racing the per-step poll from another
+        thread in tests/benches) used to be able to interleave their
+        writes into one snapshot directory; now each write completes
+        whole, into unambiguously-named files (regression-pinned)."""
+        with self._dump_lock:
+            try:
+                if self.dump_dir is None:
+                    self.dump_dir = tempfile.mkdtemp(
+                        prefix="serve-watchdog-")
+                d = os.path.join(
+                    self.dump_dir,
+                    f"{kind}-{self.trips[kind]}-{int(time.time())}")
+                os.makedirs(d, exist_ok=True)
+                self.engine.flight.dump(
+                    os.path.join(d, f"flight-{kind}.jsonl"))
+                with open(os.path.join(d, f"trace-{kind}.json"), "w") as f:
+                    json.dump(self.engine.tracer.export_chrome(), f)
+                with open(os.path.join(d, f"meta-{kind}.json"), "w") as f:
+                    json.dump({"trip": info, "trips": dict(self.trips),
+                               "stats": self.engine.stats()}, f,
+                              default=str)
+                return d
+            except OSError:
+                self.dump_errors += 1
+                return None
 
     # ------------------------------------------------------------- views
     def reset(self) -> None:

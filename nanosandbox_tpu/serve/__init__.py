@@ -27,19 +27,31 @@ Pieces:
   spec.py      — SpecRunner: the fixed-shape batched verification step
                  (k+1 positions per slot, one program) + rejection
                  sampling with per-row accepted lengths.
+  faults.py    — FaultPlan: deterministic, seeded fault injection at
+                 named hot-path sites (chaos testing; zero cost when
+                 no plan is attached).
+  recovery.py  — EngineSupervisor: crash-safe stepping — quarantine,
+                 device-state rebuild, re-admission of in-flight
+                 requests, bounded backoff, permanent-failure drain.
   __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
                  checkpoint and serve it.
 """
 
 from nanosandbox_tpu.serve.drafters import (ModelDrafter, NGramDrafter,
                                             drafter_from_flag)
-from nanosandbox_tpu.serve.engine import Engine, Request, Result
+from nanosandbox_tpu.serve.engine import (Engine, EngineFailedError,
+                                          Request, Result)
+from nanosandbox_tpu.serve.faults import (CANNED, FaultInjected, FaultPlan,
+                                          FaultSpec)
 from nanosandbox_tpu.serve.paged import (Allocation, BlockPool,
                                          RadixPrefixCache, blocks_for)
+from nanosandbox_tpu.serve.recovery import EngineSupervisor
 from nanosandbox_tpu.serve.scheduler import (SlotScheduler, admit_ladder,
                                              default_buckets)
 
 __all__ = ["Engine", "Request", "Result", "SlotScheduler",
            "admit_ladder", "default_buckets", "NGramDrafter",
            "ModelDrafter", "drafter_from_flag", "BlockPool",
-           "RadixPrefixCache", "Allocation", "blocks_for"]
+           "RadixPrefixCache", "Allocation", "blocks_for",
+           "FaultPlan", "FaultSpec", "FaultInjected", "CANNED",
+           "EngineSupervisor", "EngineFailedError"]

@@ -98,6 +98,23 @@ def main(argv: list[str] | None = None) -> None:
                     help="disable the anomaly watchdogs (TTFT spike, "
                          "admission stall, pool thrash, post-warmup "
                          "retrace, stuck slot)")
+    ap.add_argument("--faults", default=None,
+                    help="arm a deterministic fault-injection plan "
+                         "(serve/faults.py) for chaos drills: "
+                         "'site@step[xN][:param]' entries comma-"
+                         "separated, or a canned plan name "
+                         "('chaos-smoke', 'chaos-full'). Steps are "
+                         "relative to the END of warmup. NEVER default "
+                         "on: production pays zero cost without it")
+    ap.add_argument("--no_recovery", action="store_true",
+                    help="disable the crash-safe engine supervisor "
+                         "(quarantine + device-state rebuild + "
+                         "re-admission on poisoned steps/watchdog "
+                         "trips/dispatch crashes); without it a "
+                         "dispatch crash kills the serving loop and a "
+                         "persistently poisoned row terminates "
+                         "'failed' after 3 strikes instead of "
+                         "recovering")
     ap.add_argument("--warmup", choices=("full", "buckets"), default="full",
                     help="'full' compiles every (wave-size, bucket) "
                          "prefill pair before binding the port (the "
@@ -140,6 +157,19 @@ def main(argv: list[str] | None = None) -> None:
                 f"--shardcheck_budget={budget_path}: no such file (only "
                 "the implicit default is skipped when absent)")
 
+    # Fault plan (chaos drills): parsed BEFORE the expensive restore so
+    # a typo fails in milliseconds; armed only after warmup — the
+    # plan's relative steps aim at live traffic, never at compile time.
+    fault_plan = None
+    if args.faults:
+        from nanosandbox_tpu.serve.faults import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.parse(args.faults)
+        except ValueError as e:
+            raise SystemExit(f"--faults: {e}")
+        fault_plan.enabled = False
+
     trainer, state, step = restore_for_inference(
         args.out_dir, data_dir=args.data_dir, device=args.device)
     params = cast_params_for_serving(state["params"],
@@ -162,7 +192,8 @@ def main(argv: list[str] | None = None) -> None:
                     prefix_cache=not args.no_prefix_cache,
                     watchdogs=not args.no_watchdogs,
                     watchdog_dir=args.watchdog_dir,
-                    default_deadline_s=args.deadline_s or None)
+                    default_deadline_s=args.deadline_s or None,
+                    faults=fault_plan)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -242,7 +273,19 @@ def main(argv: list[str] | None = None) -> None:
         export_manifest_metrics(shardcheck_budget, global_registry())
         print(f"[serve] shardcheck budget {budget_path} exported to "
               "/metrics", file=sys.stderr, flush=True)
-    loop = EngineLoop(engine)
+    if fault_plan is not None:
+        # Arm at the post-warmup step: the plan's relative schedule
+        # targets live traffic.
+        fault_plan.rearm(engine.steps)
+        fault_plan.enabled = True
+        print(f"[serve] CHAOS: fault plan armed — "
+              f"{fault_plan.describe()}", file=sys.stderr, flush=True)
+    supervisor = None
+    if not args.no_recovery:
+        from nanosandbox_tpu.serve.recovery import EngineSupervisor
+
+        supervisor = EngineSupervisor(engine)
+    loop = EngineLoop(engine, supervisor=supervisor)
     loop.start()
     server = make_server(args.host, args.port, loop, tok.encode,
                          lambda ids: tok.decode([int(t) for t in ids]))
@@ -252,11 +295,13 @@ def main(argv: list[str] | None = None) -> None:
                  if engine.paged else "dense per-slot rows")
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
           f"{engine.max_len} ctx ({pool_desc}, kv_dtype={engine.kv_dtype}, "
-          f"decode_impl={engine.decode_impl}); prefill buckets "
+          f"decode_impl={engine.decode_impl}, recovery="
+          f"{'off' if supervisor is None else 'on'}); prefill buckets "
           f"{engine.sched.buckets}; listening on "
-          f"{args.host}:{args.port} (POST /generate, GET /healthz "
-          "/stats /metrics /trace /debug/requests /debug/slots "
-          "/debug/kvpool /debug/scheduler, POST /profile)",
+          f"{args.host}:{args.port} (POST /generate /drain /profile, "
+          "GET /healthz[?ready=1] /stats /metrics /trace "
+          "/debug/requests /debug/slots /debug/kvpool "
+          "/debug/scheduler)",
           file=sys.stderr, flush=True)
     # After a FULL warmup the compile set is complete by contract, so
     # freeze the retrace budgets: a compile after /healthz went green

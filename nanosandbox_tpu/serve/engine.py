@@ -71,7 +71,7 @@ import tempfile
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -79,9 +79,26 @@ import numpy as np
 from nanosandbox_tpu.obs import (FlightRecorder, MetricRegistry, SLOLedger,
                                  SpanTracer, WatchdogPanel,
                                  validate_slo_class)
+from nanosandbox_tpu.serve.faults import FaultInjected, FaultPlan
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
 from nanosandbox_tpu.utils import tracecheck as _tracecheck
 from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
+
+
+# Consecutive poisoned readbacks a row survives before it terminates
+# 'failed' — the UNSUPERVISED backstop: with an EngineSupervisor the
+# first poison triggers a recovery (fresh row state, counter gone), so
+# the limit is only ever reached when nobody is recovering and the
+# poison is persistent (bad checkpoint, broken device). Pre-PR-11 such
+# a row terminated with garbage tokens; wedging the slot forever would
+# be strictly worse.
+POISON_STRIKE_LIMIT = 3
+
+
+class EngineFailedError(RuntimeError):
+    """The engine escalated to permanent failure (recovery exhausted its
+    attempts) and drained; submissions are refused until a restart. The
+    HTTP layer maps this to 503 — clients should hit another replica."""
 
 
 @dataclass(frozen=True)
@@ -107,7 +124,7 @@ class Result:
     rid: int
     prompt: tuple
     tokens: List[int]          # generated ids (includes the eos hit, if any)
-    finish_reason: str         # 'length' | 'eos' | 'shed'
+    finish_reason: str         # 'length' | 'eos' | 'shed' | 'failed'
 
 
 @dataclass
@@ -121,6 +138,20 @@ class _Active:
     spec_accepted: int = 0       # draft tokens this request accepted
     span: int = 0                # open "generate" span id (obs tracer)
     alloc: object = None         # paged.Allocation (block-paged engines)
+    poison_strikes: int = 0      # consecutive poisoned readbacks (row
+    #                              terminates 'failed' at the cap when
+    #                              no supervisor recovers in between)
+
+
+@dataclass
+class _Resume:
+    """Host-side stitch record for a request re-admitted after an
+    engine recovery: the ORIGINAL prompt and the tokens generated
+    before the fault, so the terminal Result (and its flight/SLO
+    accounting) reads as one uninterrupted request."""
+    prompt: tuple
+    tokens: List[int]
+    submit_t: float
 
 
 class Engine:
@@ -215,6 +246,17 @@ class Engine:
         burning a slot on an answer its client stopped waiting for —
         and every deadline-carrying request lands in the SLO ledger
         (attainment, goodput tokens, deadline margin) on /metrics.
+    faults : a serve.faults.FaultPlan injecting deterministic failures
+        at named hot-path sites (nan_logits, slow_step, alloc_fail,
+        drafter_fault, scatter_corrupt, prefill_exc) — chaos testing
+        and the recovery subsystem's test bench. None (the default)
+        reduces every site to one `is None` branch: production pays
+        nothing, and the compile set / host-sync ledger are identical
+        with and without the hook (pinned by test).
+    spec_fault_tolerance : consecutive drafter faults absorbed (each
+        degrades that step to plain decode) before speculative decoding
+        auto-DISABLES for the engine's lifetime — degrade, don't die:
+        a dead drafter costs throughput, never correctness or uptime.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -231,7 +273,9 @@ class Engine:
                  flight: Optional[FlightRecorder] = None,
                  watchdogs: bool = True,
                  watchdog_dir: Optional[str] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 faults: Optional[FaultPlan] = None,
+                 spec_fault_tolerance: int = 3):
         import jax
         import jax.numpy as jnp
 
@@ -312,25 +356,16 @@ class Engine:
             self.kv_pool_blocks = 0
             self._pool = init_cache(cfg, num_slots, self.max_len,
                                     kv_dtype=kv_dtype)
+        # The kv_dtype ARGUMENT (not the resolved mode): recover() must
+        # rebuild the pool with exactly the constructor's layout.
+        self._kv_dtype_arg = kv_dtype
         # Device-resident per-slot decode operands. Idle rows keep
         # harmless parked values (pos 0, temperature 0, active False):
         # their garbage decode writes stay inside their own slot row —
         # paged engines park the block-table row on the out-of-range
         # sentinel (kv_pool_blocks) instead, so an idle row's garbage
         # writes DROP rather than touch a block it no longer owns.
-        self._state = {
-            "pos": jnp.zeros(num_slots, jnp.int32),
-            "tok": jnp.zeros(num_slots, jnp.int32),
-            "temp": jnp.zeros(num_slots, jnp.float32),
-            "topk": jnp.zeros(num_slots, jnp.int32),
-            "topp": jnp.ones(num_slots, jnp.float32),
-            "seed": jnp.zeros(num_slots, jnp.int32),
-            "active": jnp.zeros(num_slots, jnp.bool_),
-        }
-        if self.paged:
-            self._state["table"] = jnp.full(
-                (num_slots, self.slot_blocks), self.kv_pool_blocks,
-                jnp.int32)
+        self._state = self._fresh_slot_state()
 
         self._active: Dict[int, _Active] = {}        # slot -> state
         self._pending_results: List[Result] = []     # max_new_tokens == 0
@@ -351,6 +386,31 @@ class Engine:
         self.tokens_generated = 0
         self.shed = 0                                # deadline-expired drops
         self.rejected: Dict[str, int] = {}           # submit rejects, by kind
+        # Fault-injection + crash-safe recovery state (ISSUE 11). The
+        # hooks cost one `is None` branch each when no plan is attached;
+        # recovery bookkeeping is cold-path only.
+        self.faults = faults
+        if faults is not None:
+            faults.arm(0)
+        self.spec_fault_tolerance = int(spec_fault_tolerance)
+        self.quarantined = False
+        self.quarantine_cause: Optional[str] = None
+        self.failed = False
+        self.recoveries = 0
+        self.poisoned_steps = 0
+        self.requeued = 0
+        self.drafter_faults = 0
+        self.spec_disabled_reason: Optional[str] = None
+        self._drafter_fault_streak = 0
+        self._poison: Optional[dict] = None
+        # The wave currently mid-prefill: (req, slot, alloc) triples,
+        # populated between the queue pop and the admission commit so a
+        # prefill-dispatch crash leaves recover() enough to requeue.
+        # _admitting_span is the wave's open tracer span, ended by
+        # recover()/abort_all() when a crash skips the normal close.
+        self._admitting: List[Tuple] = []
+        self._admitting_span: Optional[int] = None
+        self._resumed: Dict[int, _Resume] = {}
         if default_deadline_s is not None and default_deadline_s <= 0:
             raise ValueError(f"default_deadline_s must be > 0, got "
                              f"{default_deadline_s}")
@@ -456,6 +516,35 @@ class Engine:
         self._c_shed = m.counter(
             "serve_requests_shed_total",
             "Queued requests shed after their deadline expired.")
+        # Crash-safe recovery signal (ISSUE 11): recovery cycles by
+        # cause, rebuild latency, poisoned steps caught by the in-
+        # program isfinite guard, re-admissions, drafter faults, and a
+        # quarantine gauge readiness probes can alert on. Counters with
+        # labels mint children only when the event happens (hygiene);
+        # all are cold-path — a recovery is already an outage moment.
+        self._c_recoveries = m.counter(
+            "serve_engine_recoveries_total",
+            "Engine quarantine -> rebuild -> re-admit cycles, by cause.",
+            labelnames=("cause",))
+        self._h_recovery = m.histogram(
+            "serve_engine_recovery_seconds",
+            "Quarantine -> device state rebuilt and victims requeued.",
+            unit="seconds",
+            buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0))
+        self._c_poisoned = m.counter(
+            "serve_poisoned_steps_total",
+            "Steps whose readback carried poisoned (non-finite-logit "
+            "or out-of-vocab) tokens.")
+        self._c_requeued = m.counter(
+            "serve_requests_requeued_total",
+            "In-flight requests re-admitted after an engine recovery.")
+        self._c_drafter_faults = m.counter(
+            "serve_spec_drafter_faults_total",
+            "Drafter faults absorbed (the step degraded to plain "
+            "decode).")
+        self._g_quarantined = m.gauge(
+            "serve_engine_quarantined",
+            "1 while the engine is quarantined for recovery, else 0.")
         self.slo = SLOLedger(m)
         self.flight = flight if flight is not None else FlightRecorder()
         self.watchdog = WatchdogPanel(self, dump_dir=watchdog_dir,
@@ -553,6 +642,27 @@ class Engine:
     def _meta_width(self) -> int:
         return (self.slot_blocks + 5) if self.paged else 4
 
+    def _fresh_slot_state(self) -> dict:
+        """A fully-parked device slot-state dict — construction AND the
+        recovery rebuild use the same one, so a recovered engine starts
+        from exactly the state a fresh one would."""
+        import jax.numpy as jnp
+
+        state = {
+            "pos": jnp.zeros(self.num_slots, jnp.int32),
+            "tok": jnp.zeros(self.num_slots, jnp.int32),
+            "temp": jnp.zeros(self.num_slots, jnp.float32),
+            "topk": jnp.zeros(self.num_slots, jnp.int32),
+            "topp": jnp.ones(self.num_slots, jnp.float32),
+            "seed": jnp.zeros(self.num_slots, jnp.int32),
+            "active": jnp.zeros(self.num_slots, jnp.bool_),
+        }
+        if self.paged:
+            state["table"] = jnp.full(
+                (self.num_slots, self.slot_blocks), self.kv_pool_blocks,
+                jnp.int32)
+        return state
+
     def _split_meta(self, meta, fmeta):
         nb = self.slot_blocks if self.paged else 0
         tables = meta[:, :nb] if self.paged else None
@@ -591,7 +701,7 @@ class Engine:
         keys = row_keys(seeds, true_lens)
         toks, _ = _sample_token(last, keys, temperature=temps,
                                 top_k=top_ks, top_p=top_ps)
-        return new_pool, toks
+        return new_pool, self._poison_guard(toks, last)
 
     def _prefill_paged_fn(self, params, pool, suffix, meta, fmeta):
         """Paged admission wave: (k, L_suffix_bucket) SUFFIX tokens ->
@@ -629,7 +739,7 @@ class Engine:
         keys = row_keys(seeds, true_lens)
         toks, _ = _sample_token(last, keys, temperature=temps,
                                 top_k=top_ks, top_p=top_ps)
-        return pool, toks
+        return pool, self._poison_guard(toks, last)
 
     def _decode_fn(self, params, pool, state):
         """One batched token step over ALL slots at per-row frontiers.
@@ -654,11 +764,25 @@ class Engine:
         nxt, _ = _sample_token(logits[:, 0, :], keys,
                                temperature=state["temp"],
                                top_k=state["topk"], top_p=state["topp"])
+        nxt = self._poison_guard(nxt, logits[:, 0, :])
         active = state["active"]
         new_state = dict(state,
                          pos=state["pos"] + active.astype(jnp.int32),
                          tok=jnp.where(active, nxt, state["tok"]))
         return pool, new_state, nxt
+
+    def _poison_guard(self, toks, logits):
+        """In-program NaN/inf sentinel: a row whose logits went non-
+        finite would otherwise sample an arbitrary-but-valid token
+        (argmax over NaN is 0) and poison its KV history silently —
+        instead the sampled token is replaced with the out-of-vocab
+        sentinel, which the host retire loop detects for free from the
+        readback it already performs (no extra sync, no extra program;
+        the recovery supervisor turns the detection into a rebuild)."""
+        import jax.numpy as jnp
+
+        ok = jnp.isfinite(logits).all(axis=-1)
+        return jnp.where(ok, toks, jnp.int32(self.cfg.vocab_size))
 
     def _admit_fn(self, state, toks, meta, fmeta):
         """Scatter an admission wave's operands into the slot-state rows.
@@ -716,6 +840,10 @@ class Engine:
         self._c_shed._set_total(self.shed)
         for reason, n in list(self.rejected.items()):
             self._c_rejected.labels(reason=reason)._set_total(n)
+        self._c_poisoned._set_total(self.poisoned_steps)
+        self._c_requeued._set_total(self.requeued)
+        self._c_drafter_faults._set_total(self.drafter_faults)
+        self._g_quarantined.set(1.0 if self.quarantined else 0.0)
         self._g_active.set(len(self._active))
         self._g_free.set(self.sched.free_slots)
         self._g_queued.set(self.sched.queued)
@@ -755,6 +883,17 @@ class Engine:
         shedding; ``slo_class`` labels it on /metrics."""
         prompt = tuple(int(t) for t in prompt)
         plen = len(prompt)
+        if self.failed:
+            # Permanent failure drains, it does not crash-loop: refuse
+            # loudly (503 upstream) instead of queueing into a void.
+            self.rejected["engine_failed"] = \
+                self.rejected.get("engine_failed", 0) + 1
+            self.flight.record("reject", step=self.steps,
+                               reason="engine_failed", prompt_len=plen)
+            raise EngineFailedError(
+                "engine permanently failed "
+                f"({self.quarantine_cause or 'unknown cause'}); "
+                "restart the process or route to another replica")
         if not prompt:
             self._reject("empty_prompt",
                          "empty prompt (encode at least one token)")
@@ -845,14 +984,34 @@ class Engine:
         the PREVIOUS step's readback (pipelined; with pipeline=False the
         readback is the step just dispatched). Returns the requests that
         finished during this call."""
+        if self.failed:
+            # A permanently-failed engine only flushes already-terminal
+            # results; abort_all() has drained everything else.
+            finished, self._pending_results = self._pending_results, []
+            return finished
+        t0 = time.monotonic()
+        traces0 = sum(self.tracecheck.counts().values())
         self._profile_window_start()
         finished = self._step_impl()
         self._profile_window_advance()
+        # A single step stalling for tens of seconds is a wedged device,
+        # not load — feed the stalled_step watchdog from the wall time
+        # the step just took (one float compare when healthy). A step
+        # that COMPILED something (--warmup=buckets lazy waves, tests)
+        # is legitimately slow and must not read as a wedge: tearing
+        # down a healthy replica for compiling would be recovery-
+        # induced outage.
+        if sum(self.tracecheck.counts().values()) == traces0:
+            self.watchdog.on_step_time(time.monotonic() - t0)
         self.watchdog.check()
         return finished
 
     def _step_impl(self) -> List[Result]:
-        finished, self._pending_results = self._pending_results, []
+        # ``finished`` IS self._pending_results until the successful
+        # detach at each return: an exception mid-step (device failure,
+        # injected fault) must not strand already-terminal Results —
+        # the supervisor's next step delivers them after recovery.
+        finished = self._pending_results
 
         # Shed queued requests whose deadline already passed — BEFORE
         # admission, so an expired request never eats a slot, a prefill
@@ -871,13 +1030,31 @@ class Engine:
                 # Slots the retire just freed backfill NOW, same as the
                 # pipelined loop's post-retire admission.
                 self._admit_waves(finished)
+            self._pending_results = []
             return finished
 
         retired = False
         if self._active and self._needs_decode():
+            if self.faults is not None:
+                f = self.faults.fire("slow_step", self.steps)
+                if f is not None:
+                    self.flight.record("fault", step=self.steps,
+                                       site="slow_step", stall_s=f.stall_s)
+                    time.sleep(f.stall_s)
             self._pool, self._state, toks = self._decode(
                 self.params, self._pool, self._state)
             self.steps += 1
+            if (self.faults is not None
+                    and self.faults.fire("nan_logits", self.steps)
+                    is not None):
+                # Injection happens at the host boundary: the readback
+                # the retire will perform sees exactly what a real
+                # non-finite step produces (the in-program sentinel),
+                # so detection + recovery exercise the production path.
+                self.flight.record("fault", step=self.steps,
+                                   site="nan_logits")
+                toks = np.full(self.num_slots, self.cfg.vocab_size,
+                               np.int32)
             snapshot = {slot: st.req.rid
                         for slot, st in self._active.items()}
             # decode_step span: opened at DISPATCH, closed at RETIRE —
@@ -907,6 +1084,7 @@ class Engine:
             # picks the new rows up, so eviction->readmission costs the
             # same one-step lag as the synchronous loop instead of two.
             self._admit_waves(finished)
+        self._pending_results = []
         return finished
 
     def _shed_expired(self, finished: List[Result]) -> None:
@@ -929,13 +1107,24 @@ class Engine:
             self.shed += 1
             self.tracer.end(sid, {"shed": True,
                                   "wait_steps": self.steps - sub_step})
+            # A recovery-requeued victim can expire while waiting for
+            # re-admission: unstitch it like every other terminal — the
+            # Result carries the ORIGINAL prompt and the salvaged
+            # pre-fault tokens, and the _Resume record must not leak.
+            prompt_out, tokens_out, resumed = self._unstitch(
+                req.rid, req, [])
+            shed_fields = {"waited_s": round(now - sub_t, 6),
+                           "deadline_s": req.deadline_s,
+                           "slo_class": req.slo_class}
+            if resumed:
+                shed_fields["resumed"] = True
+                shed_fields["tokens"] = len(tokens_out)
             self.flight.record("shed", rid=req.rid, step=self.steps,
-                               waited_s=round(now - sub_t, 6),
-                               deadline_s=req.deadline_s,
-                               slo_class=req.slo_class)
+                               **shed_fields)
             self.slo.record_shed(req.slo_class)
-            finished.append(Result(rid=req.rid, prompt=req.prompt,
-                                   tokens=[], finish_reason="shed"))
+            finished.append(Result(rid=req.rid, prompt=prompt_out,
+                                   tokens=tokens_out,
+                                   finish_reason="shed"))
 
     def drain(self) -> List[Result]:
         """Run step() until queue, slots and pipeline are empty."""
@@ -1108,6 +1297,23 @@ class Engine:
             "shed": self.shed,
             "rejected": dict(self.rejected),
             "default_deadline_s": self.default_deadline_s,
+            # Fault/recovery posture (ISSUE 11): what readiness probes
+            # and the /debug views key off, plus the armed fault plan
+            # when chaos testing.
+            "recovery": {
+                "quarantined": self.quarantined,
+                "failed": self.failed,
+                "cause": self.quarantine_cause,
+                "recoveries": self.recoveries,
+                "recovery_s": self._h_recovery.percentiles((50, 90, 99)),
+                "poisoned_steps": self.poisoned_steps,
+                "requeued": self.requeued,
+                "resumed_in_flight": len(self._resumed),
+                "drafter_faults": self.drafter_faults,
+                "spec_disabled": self.spec_disabled_reason,
+            },
+            "faults": (None if self.faults is None
+                       else self.faults.stats()),
             "slo": self.slo.stats(),
             "flight": self.flight.stats(),
             "watchdog": self.watchdog.stats(),
@@ -1325,6 +1531,19 @@ class Engine:
             if self.paged:
 
                 def try_alloc(req):
+                    if (self.faults is not None
+                            and self.faults.fire("alloc_fail", self.steps)
+                            is not None):
+                        # Forced exhaustion: the request stays queued
+                        # (the normal no-deadlock backpressure), the
+                        # stall is counted so the admission_stall
+                        # watchdog sees the same signal a real one
+                        # produces.
+                        self.block_pool.stall_steps += 1
+                        self.flight.record("fault", rid=req.rid,
+                                           step=self.steps,
+                                           site="alloc_fail")
+                        return False
                     a = self.block_pool.admit(req.prompt,
                                               req.max_new_tokens)
                     if a is None:
@@ -1348,12 +1567,20 @@ class Engine:
             if wave is None:
                 break
             reqs, slots, bucket = wave
+            # From here until the admission commits, the wave is in
+            # limbo: popped from the queue, blocks reserved, slots
+            # claimed, but not yet active. Track it so a prefill crash
+            # leaves recover() enough to unwind and requeue.
+            self._admitting = [
+                (req, slot, allocs[i] if self.paged else None)
+                for i, (req, slot) in enumerate(zip(reqs, slots))]
             k = self.sched.rung_for(len(reqs))
             self._c_waves.inc()
             wave_sid = self.tracer.begin(
                 "prefill_wave", cat="prefill",
                 args={"bucket": bucket, "rung": k, "wave": len(reqs),
                       "rids": [r.rid for r in reqs]})
+            self._admitting_span = wave_sid
             # Host staging for the wave — the ONLY host->device uploads
             # the engine performs (three arrays, the packed layout above
             # _meta_width); the per-token loop stages nothing.
@@ -1387,6 +1614,13 @@ class Engine:
             prompts_dev = jnp.asarray(prompts)
             meta_dev = jnp.asarray(meta)
             fmeta_dev = jnp.asarray(fmeta)
+            if (self.faults is not None
+                    and self.faults.fire("prefill_exc", self.steps)
+                    is not None):
+                self.flight.record("fault", step=self.steps,
+                                   site="prefill_exc",
+                                   rids=[r.rid for r in reqs])
+                raise FaultInjected("prefill_exc", self.steps)
             self._pool, toks = self._prefill(self.params, self._pool,
                                              prompts_dev, meta_dev,
                                              fmeta_dev)
@@ -1403,19 +1637,42 @@ class Engine:
                 self._spec.drafter.prefill_wave(prompts_dev, meta_dev)
             # jaxlint: disable=host-sync -- first-token readback feeds results/eos checks
             toks_host = np.asarray(toks)
+            if (self.faults is not None
+                    and self.faults.fire("scatter_corrupt", self.steps)
+                    is not None):
+                # A corrupted slot scatter surfaces as garbage first
+                # tokens at the wave readback — same detection boundary
+                # as a poisoned decode step.
+                self.flight.record("fault", step=self.steps,
+                                   site="scatter_corrupt")
+                toks_host = np.full(k, self.cfg.vocab_size, np.int32)
             now = time.monotonic()
             self._rate_ring.append((now, len(reqs)))
+            poisoned_wave = False
             for i, (req, slot) in enumerate(zip(reqs, slots)):
                 self.admitted += 1
-                self.tokens_generated += 1
+                first_tok = int(toks_host[i])
+                poisoned = not 0 <= first_tok < self.cfg.vocab_size
+                poisoned_wave = poisoned_wave or poisoned
+                resumed = req.rid in self._resumed
+                if not poisoned:
+                    self.tokens_generated += 1
                 sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
                 self._queue_wait.observe(self.steps - sub_step)
-                self._ttft.observe(now - sub_t)
-                self.watchdog.on_ttft(now - sub_t)
+                if not resumed and not poisoned:
+                    # A resumed request's first token predates the
+                    # recovery — re-observing submit->now as "TTFT"
+                    # would poison the spike watchdog's baseline; the
+                    # recovery histograms carry that latency instead.
+                    # A POISONED first token was discarded: its latency
+                    # describes nothing the client ever received.
+                    self._ttft.observe(now - sub_t)
+                    self.watchdog.on_ttft(now - sub_t)
                 alloc = allocs[i] if self.paged else None
                 hit_toks = (alloc.n_hit * self.kv_page_size
                             if alloc is not None else 0)
-                if self.paged and self.block_pool.cache is not None:
+                if (self.paged and self.block_pool.cache is not None
+                        and not resumed and not poisoned):
                     # The by-prefix-outcome TTFT split exists only when
                     # the prefix cache does — a cache-less engine must
                     # not mint placeholder {prefix=} series (the
@@ -1437,13 +1694,21 @@ class Engine:
                     "generate", cat="request", rid=req.rid,
                     args={"slot": slot, "bucket": bucket})
                 st = _Active(req=req, slot=slot,
-                             tokens=[int(toks_host[i])], first_token_t=now,
+                             tokens=[] if poisoned else [first_tok],
+                             first_token_t=now,
                              submit_t=sub_t, last_t=now,
                              span=gen_sid, alloc=alloc)
                 self._active[slot] = st
-                done = self._maybe_finish(st)
-                if done is not None:
-                    finished.append(done)
+                if not poisoned:
+                    done = self._maybe_finish(st)
+                    if done is not None:
+                        finished.append(done)
+            # Wave committed: nothing is in limbo anymore.
+            self._admitting = []
+            self._admitting_span = None
+            if poisoned_wave:
+                self._mark_poison("poisoned_prefill",
+                                  rids=[r.rid for r in reqs])
             self.tracer.end(wave_sid)
 
     def _spec_step(self, finished: List[Result]) -> None:
@@ -1460,63 +1725,112 @@ class Engine:
         (submit already bounds prompt + max_new there)."""
         import jax
 
-        k = self._spec.k
-        drafter = self._spec.drafter
+        # Local handle: _disable_spec (drafter-fault streak) nulls
+        # self._spec mid-call; the already-dispatched verify still
+        # retires through this runner.
+        runner = self._spec
+        k = runner.k
+        drafter = runner.drafter
         verify_sid = self.tracer.begin(
             "spec_verify", cat="spec",
             args={"k": k, "rows": len(self._active)})
         caps = {slot: min(k, st.req.max_new_tokens - len(st.tokens) - 1)
                 for slot, st in self._active.items()}
         dl = np.zeros(self.num_slots, np.int32)
-        if drafter.kind == "host":
-            # The ONLY per-step host->device transfer spec mode adds: the
-            # (num_slots, k) + (num_slots,) int32 blocks ride the verify
-            # dispatch itself (numpy args into jit measure ~25% cheaper
-            # per CPU verify than a separate device_put round).
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        try:
+            if (self.faults is not None
+                    and self.faults.fire("drafter_fault", self.steps)
+                    is not None):
+                raise FaultInjected("drafter_fault", self.steps)
+            if drafter.kind == "host":
+                # The ONLY per-step host->device transfer spec mode adds:
+                # the (num_slots, k) + (num_slots,) int32 blocks ride the
+                # verify dispatch itself (numpy args into jit measure
+                # ~25% cheaper per CPU verify than a separate device_put
+                # round).
+                for slot, st in self._active.items():
+                    if caps[slot] <= 0:
+                        continue
+                    prop = drafter.propose(list(st.req.prompt) + st.tokens,
+                                           caps[slot])
+                    dl[slot] = len(prop)
+                    drafts[slot, :len(prop)] = prop
+            else:
+                drafts = drafter.draft(self._state["tok"],
+                                       self._state["pos"],
+                                       self._state["active"],
+                                       table=self._state.get("table"))
+                for slot, cap in caps.items():
+                    dl[slot] = max(cap, 0)
+        except Exception as e:
+            # Degrade, don't die: a drafter failure turns THIS step into
+            # plain decode (zero drafts -> the verify's always-emitted
+            # fresh token is the only output), and a streak of them
+            # disables speculation for good — correctness and uptime
+            # never depend on the drafter.
+            self.drafter_faults += 1
+            self._drafter_fault_streak += 1
+            dl[:] = 0
             drafts = np.zeros((self.num_slots, k), np.int32)
-            for slot, st in self._active.items():
-                if caps[slot] <= 0:
-                    continue
-                prop = drafter.propose(list(st.req.prompt) + st.tokens,
-                                       caps[slot])
-                dl[slot] = len(prop)
-                drafts[slot, :len(prop)] = prop
+            self.flight.record("drafter_fault", step=self.steps,
+                               error=f"{type(e).__name__}: {e}",
+                               streak=self._drafter_fault_streak)
+            if self._drafter_fault_streak >= self.spec_fault_tolerance:
+                self._disable_spec(
+                    f"{self._drafter_fault_streak} consecutive drafter "
+                    f"faults (last: {type(e).__name__}: {e})")
         else:
-            drafts = drafter.draft(self._state["tok"], self._state["pos"],
-                                   self._state["active"],
-                                   table=self._state.get("table"))
-            for slot, cap in caps.items():
-                dl[slot] = max(cap, 0)
+            self._drafter_fault_streak = 0
         self._pool, self._state, emitted, counts, accepted = \
-            self._spec.verify(self.params, self._pool, self._state,
-                              drafts, dl)
+            runner.verify(self.params, self._pool, self._state,
+                          drafts, dl)
         self.steps += 1
-        self._spec.steps += 1
+        runner.steps += 1
         # ONE batched readback for the whole retire (synchronous by
         # design — docstring; three separate np.asarray blocks cost a
         # measurable slice of the verify step on CPU).
         # jaxlint: disable=host-sync -- the spec retire: synchronous by design (docstring)
         emit_host, counts_host, acc_host = jax.device_get(
             (emitted, counts, accepted))
+        if (self.faults is not None
+                and self.faults.fire("nan_logits", self.steps) is not None):
+            # The spec twin of the decode-branch injection: the verify's
+            # emitted tokens are what the retire reads back (emit_host
+            # is already host-resident — the device_get above).
+            self.flight.record("fault", step=self.steps, site="nan_logits")
+            emit_host = np.full(np.shape(emit_host), self.cfg.vocab_size,
+                                np.int32)
         now = time.monotonic()
         n_kept = 0
+        poisoned_slots: List[int] = []
         for slot, st in list(self._active.items()):
             c = int(counts_host[slot])
             if c <= 0:
                 continue
             acc = int(acc_host[slot])
+            toks = emit_host[slot, :c].tolist()
+            if any(not 0 <= t < self.cfg.vocab_size for t in toks):
+                # Poisoned verify output: keep the row's clean tokens,
+                # let the supervisor rebuild (same contract as _retire,
+                # including the unsupervised strike backstop).
+                poisoned_slots.append(slot)
+                st.poison_strikes += 1
+                if st.poison_strikes >= POISON_STRIKE_LIMIT:
+                    self._fail_row(st, "persistent_poison", finished)
+                continue
             if dl[slot] > 0:
-                self._spec.drafted += int(dl[slot])
-                self._spec.accepted += acc
+                runner.drafted += int(dl[slot])
+                runner.accepted += acc
                 self._spec_accept_len.observe(acc)
                 st.spec_accepted += acc
-            toks = emit_host[slot, :c].tolist()
             if st.req.eos_id is not None and st.req.eos_id in toks:
                 # eos mid-chunk: the verify's tokens after it belong past
                 # the finish and are dropped — the spec twin of the
                 # pipelined ride-along drop.
                 toks = toks[:toks.index(st.req.eos_id) + 1]
             st.tokens.extend(toks)
+            st.poison_strikes = 0      # consecutive means consecutive
             st.last_t = now
             self.flight.record("retire", rid=st.req.rid, step=self.steps,
                                n=len(toks), accepted=acc)
@@ -1524,6 +1838,8 @@ class Engine:
             done = self._maybe_finish(st)
             if done is not None:
                 finished.append(done)
+        if poisoned_slots:
+            self._mark_poison("poisoned_step", slots=poisoned_slots)
         self.tokens_generated += n_kept
         self._rate_ring.append((now, n_kept))
         self.tracer.end(verify_sid,
@@ -1558,11 +1874,26 @@ class Engine:
         nxt = np.asarray(toks)
         now = time.monotonic()
         n_live = 0
+        poisoned_slots: List[int] = []
         for slot, rid in snapshot.items():
             st = self._active.get(slot)
             if st is None or st.req.rid != rid:
                 continue
-            st.tokens.append(int(nxt[slot]))
+            tok = int(nxt[slot])
+            if not 0 <= tok < self.cfg.vocab_size:
+                # The in-program isfinite sentinel (or an injected
+                # poison): the token is garbage and must never reach
+                # the request's output — the row keeps its clean
+                # tokens-so-far and the supervisor rebuilds from here.
+                # Without a supervisor the strikes accumulate and the
+                # row terminates 'failed' instead of wedging forever.
+                poisoned_slots.append(slot)
+                st.poison_strikes += 1
+                if st.poison_strikes >= POISON_STRIKE_LIMIT:
+                    self._fail_row(st, "persistent_poison", finished)
+                continue
+            st.tokens.append(tok)
+            st.poison_strikes = 0      # consecutive means consecutive
             st.last_t = now
             n_live += 1
             # One flight event per retired token per row — the ledger's
@@ -1572,6 +1903,8 @@ class Engine:
             done = self._maybe_finish(st)
             if done is not None:
                 finished.append(done)
+        if poisoned_slots:
+            self._mark_poison("poisoned_step", slots=poisoned_slots)
         self.tokens_generated += n_live
         self._rate_ring.append((now, n_live))
         self.tracer.end(sid, {"live_tokens": n_live})
@@ -1640,7 +1973,8 @@ class Engine:
 
         req = state.req
         reason = None
-        if req.eos_id is not None and state.tokens[-1] == req.eos_id:
+        if (req.eos_id is not None and state.tokens
+                and state.tokens[-1] == req.eos_id):
             reason = "eos"
         elif len(state.tokens) >= req.max_new_tokens:
             reason = "length"
@@ -1667,7 +2001,13 @@ class Engine:
             self.block_pool.release(state.alloc)
         self.completed += 1
         self._c_completed.labels(reason=reason).inc()
-        self.tracer.end(state.span, {"tokens": len(state.tokens),
+        # Stitch a recovered request back together: the Result (and its
+        # SLO/flight accounting) must read as ONE uninterrupted request
+        # — original prompt, pre-fault tokens + post-recovery tokens,
+        # end-to-end latency from the original submit.
+        prompt_out, tokens_out, resumed = self._unstitch(
+            req.rid, req, state.tokens)
+        self.tracer.end(state.span, {"tokens": len(tokens_out),
                                      "finish_reason": reason})
         # SLO + flight terminal: end-to-end latency vs deadline, tokens
         # into the goodput ledger, the exactly-once `finish` event.
@@ -1675,12 +2015,14 @@ class Engine:
         prefix = ("hit" if state.alloc is not None and state.alloc.n_hit
                   else "miss")
         met = self.slo.record_finish(req.slo_class,
-                                     tokens=len(state.tokens),
+                                     tokens=len(tokens_out),
                                      elapsed_s=elapsed,
                                      deadline_s=req.deadline_s,
                                      prefix=prefix)
-        fin = {"reason": reason, "tokens": len(state.tokens),
+        fin = {"reason": reason, "tokens": len(tokens_out),
                "e2e_s": round(elapsed, 6)}
+        if resumed:
+            fin["resumed"] = True
         if met is not None:
             fin["deadline_met"] = met
         self.flight.record("finish", rid=req.rid, step=self.steps, **fin)
@@ -1689,5 +2031,276 @@ class Engine:
         if len(state.tokens) > 1:
             self._tpot.observe((now - state.first_token_t)
                                / (len(state.tokens) - 1))
-        return Result(rid=req.rid, prompt=req.prompt, tokens=state.tokens,
+        return Result(rid=req.rid, prompt=prompt_out, tokens=tokens_out,
                       finish_reason=reason)
+
+    # ------------------------------------------------------------------
+    # fault detection, quarantine & crash-safe recovery (ISSUE 11).
+    # The engine owns the MECHANISM (detect poison, rebuild device
+    # state, re-admit victims); serve/recovery.py's EngineSupervisor
+    # owns the POLICY (when to recover, backoff, permanent-failure
+    # escalation).
+    # ------------------------------------------------------------------
+    def _mark_poison(self, kind: str, **info) -> None:
+        """Record a detected poisoned step (latched until take_poison):
+        the step's outputs were discarded, the device state is suspect,
+        and the supervisor should rebuild before the next dispatch."""
+        self.poisoned_steps += 1
+        if self._poison is None:
+            self._poison = {"kind": kind, "step": self.steps, **info}
+        self.flight.record("poison", step=self.steps, kind=kind, **info)
+
+    def take_poison(self) -> Optional[dict]:
+        """The supervisor's post-step check: returns and clears the
+        latched poison detection, if any."""
+        poison, self._poison = self._poison, None
+        return poison
+
+    def _unstitch(self, rid: int, req: Request,
+                  tokens: Sequence[int]) -> Tuple[tuple, List[int], bool]:
+        """Resolve a terminal's (prompt, tokens, was_resumed) through
+        the _Resume record: EVERY terminal path (finish, shed, failed,
+        abort) must report the ORIGINAL prompt and the pre-fault tokens
+        ahead of whatever this incarnation generated — and must pop the
+        record, or a long-lived server leaks one per recovered rid."""
+        res = self._resumed.pop(rid, None)
+        if res is None:
+            return req.prompt, list(tokens), False
+        return res.prompt, res.tokens + list(tokens), True
+
+    def _fail_row(self, st: _Active, cause: str,
+                  finished: List[Result]) -> None:
+        """Terminate ONE wedged row with a 'failed' Result — the
+        unsupervised-poison backstop (POISON_STRIKE_LIMIT). A
+        supervisor-driven engine recovers after the first poison, so
+        this path means nobody is recovering and the poison is
+        persistent: free the slot, salvage the clean tokens, leave
+        exactly one terminal. No ``evict`` event — like abort_all, the
+        row never finished (evict is reserved for the finish path)."""
+        import jax.numpy as jnp
+
+        req = st.req
+        del self._active[st.slot]
+        self.sched.release(st.slot)
+        self._state = self._release(self._state,
+                                    jnp.asarray(st.slot, jnp.int32))
+        if st.alloc is not None:
+            # Prompt blocks are prefill-written (clean) — donation is
+            # safe under the same argument recover() relies on.
+            self.block_pool.release(st.alloc)
+        prompt_out, tokens_out, _ = self._unstitch(req.rid, req,
+                                                   st.tokens)
+        if req.deadline_s is not None:
+            self.slo.record_shed(req.slo_class)
+        self._c_completed.labels(reason="failed").inc()
+        self.tracer.end(st.span, {"failed": True, "cause": cause})
+        self.flight.record("failed", rid=req.rid, step=self.steps,
+                           cause=cause, tokens=len(tokens_out))
+        finished.append(Result(rid=req.rid, prompt=prompt_out,
+                               tokens=tokens_out, finish_reason="failed"))
+
+    def _disable_spec(self, reason: str) -> None:
+        """Graceful spec degradation: drop to plain synchronous decode
+        for the engine's lifetime. Outputs stay correct (greedy spec ==
+        greedy non-spec by construction); only throughput is lost."""
+        from nanosandbox_tpu.utils.metrics import warn_once
+
+        self.spec_disabled_reason = reason
+        self._spec = None
+        self.flight.record("spec_disabled", step=self.steps, reason=reason)
+        warn_once("serve-spec-disabled",
+                  f"[serve] speculative decoding DISABLED: {reason}; "
+                  "continuing with plain decode")
+
+    def quarantine(self, cause: str) -> None:
+        """Flip the engine into quarantine: readiness probes go red and
+        the supervisor rebuilds before anything else is dispatched."""
+        self.quarantined = True
+        self.quarantine_cause = cause
+        self.flight.record("quarantine", step=self.steps, cause=cause)
+
+    def _close_dangling_spans(self) -> None:
+        """End the spans a crash left open — the in-flight decode_step
+        (never retired) and a mid-prefill wave — so the tracer's open
+        table cannot grow across repeated recoveries (open_count()'s
+        zero-after-drain contract survives faults)."""
+        if self._inflight is not None:
+            self.tracer.end(self._inflight[2], {"aborted": True})
+        if self._admitting_span is not None:
+            self.tracer.end(self._admitting_span, {"aborted": True})
+            self._admitting_span = None
+
+    def recover(self, cause: str = "unknown", *,
+                flush_cache: bool = False) -> dict:
+        """Rebuild device slot state + block table from scratch and
+        re-admit every in-flight request through the normal admission
+        path.
+
+        The flight recorder and the host request journal (_active /
+        _admitting / scheduler queue) are the source of truth: each
+        victim is re-queued AT THE HEAD with prompt' = prompt +
+        tokens-generated-so-far and the remaining token budget. Row
+        keys derive from fold_in(seed, absolute_position), so the
+        resumed stream continues EXACTLY where the fault cut it —
+        greedy outputs are token-identical to a no-fault run (pinned by
+        test) and sampled outputs are identically distributed. With the
+        prefix cache on, a victim's full prompt blocks are donated at
+        release and its re-prefill is a prefix HIT: resume costs one
+        suffix prefill, not a full re-prefill.
+
+        ``flush_cache=True`` (the exception path: a dispatch crashed
+        with donated buffers possibly invalidated) additionally drops
+        the radix cache and re-materializes the KV pool arrays; the
+        poison path keeps both — a poisoned step only ever wrote its
+        rows' private frontier blocks, which are freed here and fully
+        overwritten by re-prefill before any read (the PR 9 argument).
+        """
+        t0 = time.monotonic()
+        self._close_dangling_spans()
+        self._inflight = None
+        self._poison = None
+        actives = sorted(self._active.values(), key=lambda s: s.req.rid)
+        # A crash INSIDE the wave-commit loop leaves the committed part
+        # of the wave in BOTH _active and _admitting — releasing such a
+        # slot/alloc twice would crash the recovery itself, so _active
+        # wins and the overlap is dropped from the limbo list.
+        active_rids = {st.req.rid for st in actives}
+        admitting = [entry for entry in self._admitting
+                     if entry[0].rid not in active_rids]
+        self._active = {}
+        self._admitting = []
+        requeue: List[Tuple[Request, int, Optional[float]]] = []
+        for st in actives:
+            self.sched.release(st.slot)
+            if st.alloc is not None:
+                self.block_pool.release(st.alloc)
+            self.tracer.end(st.span, {"recovered": True})
+            base = self._resumed.get(st.req.rid)
+            orig_prompt = base.prompt if base is not None else st.req.prompt
+            pre = (base.tokens if base is not None else []) + st.tokens
+            remaining = st.req.max_new_tokens - len(st.tokens)
+            req = replace(st.req,
+                          prompt=st.req.prompt + tuple(st.tokens),
+                          max_new_tokens=remaining)
+            self._resumed[req.rid] = _Resume(prompt=orig_prompt,
+                                             tokens=pre,
+                                             submit_t=st.submit_t)
+            requeue.append((req, len(pre), st.submit_t))
+        for req, slot, alloc in admitting:
+            # A wave caught mid-prefill: blocks committed, slots
+            # claimed, nothing active yet. Its submit meta (and queued
+            # span) are still open — requeue as-is.
+            self.sched.release(slot)
+            if alloc is not None:
+                self.block_pool.release(alloc)
+            base = self._resumed.get(req.rid)
+            requeue.append((req, len(base.tokens) if base else 0, None))
+        if flush_cache:
+            from nanosandbox_tpu.models.gpt import (init_cache,
+                                                    init_paged_cache)
+            if self.paged:
+                self.block_pool.reset_cache()
+                self._pool = init_paged_cache(self.cfg,
+                                              self.kv_pool_blocks,
+                                              self.kv_page_size,
+                                              kv_dtype=self._kv_dtype_arg)
+            else:
+                self._pool = init_cache(self.cfg, self.num_slots,
+                                        self.max_len,
+                                        kv_dtype=self._kv_dtype_arg)
+        self._state = self._fresh_slot_state()
+        # FIFO restoration: victims re-enter at the queue HEAD in rid
+        # (= original admission) order, ahead of traffic that arrived
+        # after them.
+        requeue.sort(key=lambda item: item[0].rid)
+        now = time.monotonic()
+        for req, done, sub_t in requeue:
+            if req.rid not in self._submit_meta:
+                sid = self.tracer.begin("queued", cat="request",
+                                        rid=req.rid,
+                                        args={"resumed": True})
+                self._submit_meta[req.rid] = (
+                    self.steps, sub_t if sub_t is not None else now, sid)
+            self.requeued += 1
+            self.flight.record("requeue", rid=req.rid, step=self.steps,
+                               cause=cause, tokens_done=done)
+        self.sched.requeue_front([item[0] for item in requeue])
+        self.recoveries += 1
+        self._c_recoveries.labels(cause=cause).inc()
+        dt = time.monotonic() - t0
+        self._h_recovery.observe(dt)
+        self.quarantined = False
+        self.quarantine_cause = None
+        self.flight.record("recover", step=self.steps, cause=cause,
+                           requeued=len(requeue), flushed=flush_cache,
+                           rebuild_s=round(dt, 6))
+        return {"cause": cause, "requeued": len(requeue),
+                "flush_cache": flush_cache, "rebuild_s": dt}
+
+    def abort_all(self, cause: str) -> List[Result]:
+        """Permanent-failure drain: terminal-fail every in-flight and
+        queued request (partial tokens are salvaged into the Result),
+        park the device state, and refuse future submissions — the
+        clean alternative to a crash loop. Each victim gets exactly one
+        terminal ``failed`` flight event."""
+        self.failed = True
+        self.quarantined = False
+        self.quarantine_cause = cause
+        self._close_dangling_spans()
+        self._inflight = None
+        self._poison = None
+        results, self._pending_results = self._pending_results, []
+        victims: List[Tuple[Request, Optional[int], object, List[int],
+                            bool]] = []
+        active_rids = set()
+        for st in sorted(self._active.values(), key=lambda s: s.req.rid):
+            self.sched.release(st.slot)
+            if st.alloc is not None:
+                self.block_pool.release(st.alloc)
+            self.tracer.end(st.span, {"failed": True})
+            active_rids.add(st.req.rid)
+            victims.append((st.req, st.slot, st.alloc, st.tokens, False))
+        for req, slot, alloc in self._admitting:
+            if req.rid in active_rids:
+                continue    # committed mid-wave: _active already owns it
+            self.sched.release(slot)
+            if alloc is not None:
+                self.block_pool.release(alloc)
+            victims.append((req, slot, alloc, [], True))
+        self._active = {}
+        self._admitting = []
+        for req in self.sched.drain_expired(lambda item: True):
+            victims.append((req, None, None, [], True))
+        self._state = self._fresh_slot_state()
+        for req, slot, alloc, toks, queued in victims:
+            meta = self._submit_meta.pop(req.rid, None)
+            if meta is not None:
+                self.tracer.end(meta[2], {"failed": True})
+            prompt_out, tokens_out, _ = self._unstitch(req.rid, req, toks)
+            if req.deadline_s is not None:
+                self.slo.record_shed(req.slo_class)
+            self._c_completed.labels(reason="failed").inc()
+            self.flight.record("failed", rid=req.rid, step=self.steps,
+                               cause=cause, tokens=len(tokens_out))
+            results.append(Result(rid=req.rid, prompt=prompt_out,
+                                  tokens=tokens_out,
+                                  finish_reason="failed"))
+        self.flight.record("engine_failed", step=self.steps, cause=cause,
+                           aborted=len(victims))
+        return results
+
+    def retry_after_s(self) -> float:
+        """Client backoff hint for 429/503 responses: the scheduler's
+        queue-wait p50 converted to wall seconds through the recent
+        step rate (fallback 1s when either signal is cold) — a shed
+        client that waits this long lands where today's admitted
+        traffic is actually clearing the queue."""
+        p = self._queue_wait.percentiles((50,))
+        ring = list(self._rate_ring)
+        if p and p.get("p50") is not None and len(ring) >= 2:
+            dt = ring[-1][0] - ring[0][0]
+            if dt > 0:
+                steps_per_s = (len(ring) - 1) / dt
+                if steps_per_s > 0:
+                    return max(0.5, p["p50"] / steps_per_s)
+        return 1.0

@@ -7,6 +7,28 @@ per-request Event until their tokens come back — so N concurrent
 clients become N rows of the same batched decode step, which is the
 entire point of the subsystem.
 
+The loop can drive either the Engine directly or (production default
+via ``python -m nanosandbox_tpu.serve``) a recovery.EngineSupervisor
+wrapping it — same ``step()`` surface, but detected faults quarantine
+and rebuild instead of killing the loop.
+
+Status hygiene (ISSUE 11): the frontend distinguishes *come back
+later* from *go away* —
+
+  429 + Retry-After   deadline/queue expiry (a shed Result): the
+                      engine is healthy but this request's patience
+                      ran out; the Retry-After derives from the
+                      scheduler's queue-wait p50.
+  503 (+ Retry-After  quarantine / draining / permanent failure /
+   while draining)    loop death: this replica cannot take the
+                      request — route elsewhere.
+  400                 the request itself is malformed (admission
+                      rules); retrying it unchanged can never help.
+
+Every /generate response leaves an ``http`` flight-recorder event with
+the returned status, so the black box shows what the CLIENT saw next
+to what the engine did.
+
 No external web framework: the repo's dependency budget is "what the
 image already ships", and http.server is plenty for a JSON
 POST /generate + GET /healthz surface. Anything fancier (streaming,
@@ -16,6 +38,7 @@ cancellation) belongs behind the same EngineLoop seam.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -23,6 +46,11 @@ from typing import Callable, Optional
 
 from nanosandbox_tpu.obs import (MetricRegistry, global_registry,
                                  render_prometheus)
+
+
+class DrainingError(RuntimeError):
+    """Raised to submitters while the loop is draining (POST /drain or
+    the k8s preStop hook): finish what's in flight, take nothing new."""
 
 
 class _Pending:
@@ -35,15 +63,24 @@ class _Pending:
 
 class EngineLoop(threading.Thread):
     """Background thread that owns the Engine: drains the submission
-    inbox, steps while any request is in flight, sleeps otherwise."""
+    inbox, steps while any request is in flight, sleeps otherwise.
 
-    def __init__(self, engine):
+    ``supervisor`` (recovery.EngineSupervisor) makes stepping
+    crash-safe: faults recover in place instead of killing the loop.
+    ``drain_now()`` flips the loop into drain mode — in-flight requests
+    finish, new submissions get DrainingError (503 upstream), and
+    readiness goes red so the fleet stops routing here."""
+
+    def __init__(self, engine, supervisor=None):
         super().__init__(daemon=True, name="serve-engine-loop")
         self.engine = engine
+        self.supervisor = supervisor
+        self._stepper = supervisor if supervisor is not None else engine
         self._cond = threading.Condition()
         self._inbox: list[_Pending] = []
         self._by_rid: dict[int, _Pending] = {}
         self._stopping = False
+        self.draining = False
         # Set when the loop dies on an engine error: /healthz keys off it
         # so a wedged engine flips the pod NotReady (and the liveness
         # probe restarts it) instead of serving 504s behind a green check.
@@ -56,6 +93,10 @@ class EngineLoop(threading.Thread):
         with self._cond:  # dead-check under the lock: no append race
             if self.dead is not None:
                 p.error = RuntimeError(f"engine loop died: {self.dead}")
+                p.done.set()
+            elif self.draining:
+                p.error = DrainingError(
+                    "server draining; retry against another replica")
                 p.done.set()
             else:
                 self._inbox.append(p)
@@ -76,6 +117,60 @@ class EngineLoop(threading.Thread):
             self._stopping = True
             self._cond.notify()
 
+    def drain_now(self) -> dict:
+        """Begin draining (idempotent): refuse new submissions, keep
+        stepping until in-flight work retires. Returns a progress view
+        — the k8s preStop hook POSTs /drain and the pod's readiness
+        goes false the same instant."""
+        with self._cond:
+            self.draining = True
+            self._cond.notify()
+            in_flight = len(self._inbox) + len(self._by_rid)
+        eng = self.engine
+        return {"draining": True,
+                "in_flight": in_flight,
+                "engine_active": len(getattr(eng, "_active", {})),
+                "queued": getattr(getattr(eng, "sched", None),
+                                  "queued", 0),
+                "drained": not eng.has_work() and in_flight == 0}
+
+    def is_ready(self) -> tuple[bool, str]:
+        """Readiness (k8s ``/healthz?ready=1``): can THIS replica take
+        a new request right now? False while draining, quarantined,
+        permanently failed, or dead — liveness may still be green (a
+        draining pod is healthy, just leaving)."""
+        if self.dead is not None:
+            return False, f"engine loop died: {self.dead}"
+        if not self.is_alive():
+            return False, "engine loop not running"
+        if self.draining:
+            return False, "draining"
+        eng = self.engine
+        if getattr(eng, "failed", False):
+            return False, "engine permanently failed"
+        if getattr(eng, "quarantined", False):
+            return False, ("quarantined: "
+                           f"{getattr(eng, 'quarantine_cause', None)}")
+        sup = self.supervisor
+        if sup is not None and sup.state != "ok":
+            return False, f"supervisor state {sup.state}"
+        return True, "ok"
+
+    def is_live(self) -> tuple[bool, str]:
+        """Liveness (k8s ``/healthz``): is the process worth keeping?
+        False once the loop is dead or the engine permanently failed —
+        both are restart-to-fix states."""
+        if self.dead is not None:
+            return False, f"engine loop died: {self.dead}"
+        if not self.is_alive():
+            return False, "engine loop not running"
+        if getattr(self.engine, "failed", False):
+            return False, "engine permanently failed"
+        sup = self.supervisor
+        if sup is not None and sup.state == "failed":
+            return False, "supervisor exhausted recovery"
+        return True, "ok"
+
     def stats(self) -> dict:
         """Loop-side in-flight accounting for /stats: requests parked in
         the inbox (not yet submitted to the engine) and requests whose
@@ -83,9 +178,13 @@ class EngineLoop(threading.Thread):
         retire a step after its last decode dispatch, so `waiting` may
         exceed the engine's `active` count by the readback lag."""
         with self._cond:
-            return {"inbox": len(self._inbox),
-                    "waiting": len(self._by_rid),
-                    "dead": self.dead}
+            out = {"inbox": len(self._inbox),
+                   "waiting": len(self._by_rid),
+                   "draining": self.draining,
+                   "dead": self.dead}
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.stats()
+        return out
 
     def run(self) -> None:
         while True:
@@ -105,12 +204,13 @@ class EngineLoop(threading.Thread):
                     p.error = e
                     p.done.set()
             try:
-                results = self.engine.step()
+                results = self._stepper.step()
             except Exception as e:
-                # An engine failure (device OOM, compile error) wedges
-                # every in-flight slot: fail ALL waiters immediately
-                # instead of letting them block to timeout, mark the loop
-                # dead so health checks go red, and exit.
+                # An engine failure (device OOM, compile error) the
+                # supervisor could not absorb wedges every in-flight
+                # slot: fail ALL waiters immediately instead of letting
+                # them block to timeout, mark the loop dead so health
+                # checks go red, and exit.
                 self.dead = f"{type(e).__name__}: {e}"
                 with self._cond:
                     self._fail_all(RuntimeError(
@@ -146,18 +246,32 @@ def make_server(host: str, port: int, loop: EngineLoop,
                      eos_id, deadline_s, slo_class}  ->  {"id",
                      "tokens", "text", "finish_reason"}. deadline_s
                      arms SLO accounting + queue-time shedding; a shed
-                     request returns finish_reason "shed" with empty
-                     tokens.
-    GET  /healthz   -> {"ok": true}
+                     request returns 429 with a Retry-After derived
+                     from the queue-wait p50; a request lost to
+                     permanent engine failure returns 503 with its
+                     partial tokens. Every response's status lands in
+                     the flight recorder as an ``http`` event.
+    POST /drain     begin graceful drain (idempotent): in-flight work
+                     finishes, new /generate gets 503 + Retry-After,
+                     readiness goes red. The k8s preStop hook calls
+                     this; response reports in-flight counts and
+                     ``drained``.
+    GET  /healthz   liveness -> {"ok": true} (503 once the loop died or
+                     the engine permanently failed — restart-to-fix).
+                     ?ready=1 -> READINESS: additionally false (503)
+                     while draining or quarantined for recovery, with
+                     the reason in the body.
     GET  /stats     -> engine counters (slots, queue, compiles) plus the
                      latency signal (decode_tokens_per_sec,
-                     queue_wait_steps_mean, ttft_s/tpot_s percentiles)
-                     and loop in-flight accounting under "loop"
+                     queue_wait_steps_mean, ttft_s/tpot_s percentiles),
+                     recovery posture under "recovery", and loop
+                     in-flight accounting under "loop"
     GET  /metrics   -> Prometheus text exposition: the engine's registry
                      (throughput, TTFT/TPOT, queue depth, compile
-                     traces, spec acceptance), the process-global one
-                     (host-sync/compile ledgers, warn_once firings) and
-                     the loop's in-flight gauges, in one scrape
+                     traces, spec acceptance, recoveries), the
+                     process-global one (host-sync/compile ledgers,
+                     warn_once firings) and the loop's in-flight
+                     gauges, in one scrape
     GET  /trace     -> Chrome trace-event JSON (Perfetto-loadable).
                      ?rid=N: one request's timeline plus the engine
                      spans overlapping it; ?last_s=S: the trailing S
@@ -186,38 +300,74 @@ def make_server(host: str, port: int, loop: EngineLoop,
                                "Requests whose waiters are still blocked.")
     g_dead = loop_reg.gauge("serve_loop_dead",
                             "1 when the engine loop has died, else 0.")
+    g_draining = loop_reg.gauge("serve_loop_draining",
+                                "1 while the loop is draining, else 0.")
 
     def _collect_loop():
         s = loop.stats()
         g_inbox.set(s["inbox"])
         g_waiting.set(s["waiting"])
         g_dead.set(0.0 if s["dead"] is None else 1.0)
+        g_draining.set(1.0 if s["draining"] else 0.0)
 
     loop_reg.add_collector(_collect_loop)
 
+    def _retry_after() -> int:
+        try:
+            return max(1, math.ceil(loop.engine.retry_after_s()))
+        except Exception:
+            return 1
+
     class Handler(BaseHTTPRequestHandler):
-        def _json(self, code: int, obj: dict) -> None:
-            self._text(code, json.dumps(obj), "application/json")
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None) -> None:
+            self._text(code, json.dumps(obj), "application/json",
+                       headers=headers)
 
         def log_message(self, fmt, *args):  # stdout stays metrics-only
             pass
 
-        def _text(self, code: int, body: str, ctype: str) -> None:
+        def _text(self, code: int, body: str, ctype: str,
+                  headers: Optional[dict] = None) -> None:
             data = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(data)
+
+        def _gen_respond(self, code: int, obj: dict,
+                         rid: Optional[int] = None,
+                         retry_after: bool = False) -> None:
+            """/generate response with status hygiene: the flight
+            recorder keeps what the client was told, 429/503 carry a
+            Retry-After the client can actually obey."""
+            fl = getattr(loop.engine, "flight", None)
+            if fl is not None:
+                fl.record("http", rid=rid, status=code)
+            headers = ({"Retry-After": _retry_after()}
+                       if retry_after else None)
+            self._json(code, obj, headers=headers)
 
         def do_GET(self):
             url = urllib.parse.urlsplit(self.path)
             if url.path == "/healthz":
-                if loop.dead is not None or not loop.is_alive():
-                    self._json(503, {"ok": False,
-                                     "error": loop.dead or "loop not running"})
-                else:
+                q = urllib.parse.parse_qs(url.query)
+                if q.get("ready", ["0"])[0] not in ("0", "", "false"):
+                    ready, reason = loop.is_ready()
+                    body = {"ok": ready, "ready": ready,
+                            "draining": loop.draining}
+                    if not ready:
+                        body["reason"] = reason
+                    self._json(200 if ready else 503, body)
+                    return
+                live, reason = loop.is_live()
+                if live:
                     self._json(200, {"ok": True})
+                else:
+                    self._json(503, {"ok": False, "error": reason})
             elif url.path == "/stats":
                 stats = loop.engine.stats()
                 stats["loop"] = loop.stats()
@@ -288,6 +438,9 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/drain":
+                self._json(200, {"ok": True, **loop.drain_now()})
+                return
             if self.path == "/profile":
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -346,24 +499,52 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 # KeyError: a char tokenizer raises it for prompt chars
                 # outside the training vocab — a client error (400), not
                 # a handler crash that closes the socket with no reply.
-                self._json(400, {"error": f"bad request: {e!r}"})
+                self._gen_respond(400, {"error": f"bad request: {e!r}"})
                 return
             try:
                 res = loop.generate(timeout=request_timeout, **kwargs)
             except ValueError as e:       # engine admission rules
-                self._json(400, {"error": str(e)})
+                self._gen_respond(400, {"error": str(e)})
                 return
             except TimeoutError as e:
-                self._json(504, {"error": str(e)})
+                self._gen_respond(504, {"error": str(e)})
                 return
-            except RuntimeError as e:     # engine loop died / shutdown
-                self._json(503, {"error": str(e)})
+            except DrainingError as e:
+                self._gen_respond(503, {"error": str(e)},
+                                  retry_after=True)
                 return
-            self._json(200, {
+            except RuntimeError as e:     # loop died / engine failed
+                self._gen_respond(503, {"error": str(e)})
+                return
+            if res.finish_reason == "shed":
+                # Deadline expired in the queue: the engine is healthy,
+                # THIS request's patience ran out — 429, try again when
+                # the queue has cleared (Retry-After says when). tokens
+                # are non-empty only for a recovery-requeued victim
+                # whose deadline expired awaiting re-admission (the
+                # salvaged pre-fault output).
+                self._gen_respond(
+                    429, {"error": "shed: deadline expired in the "
+                                   "queue",
+                          "id": res.rid, "tokens": res.tokens,
+                          "finish_reason": "shed"},
+                    rid=res.rid, retry_after=True)
+                return
+            if res.finish_reason == "failed":
+                # Permanent engine failure drained this request: the
+                # partial output is salvaged, but the replica is done —
+                # clients should route elsewhere.
+                self._gen_respond(
+                    503, {"error": "engine failed during generation",
+                          "id": res.rid, "tokens": res.tokens,
+                          "finish_reason": "failed"},
+                    rid=res.rid)
+                return
+            self._gen_respond(200, {
                 "id": res.rid,
                 "tokens": res.tokens,
                 "text": decode(list(res.prompt) + res.tokens),
                 "finish_reason": res.finish_reason,
-            })
+            }, rid=res.rid)
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,201 @@
+"""Engine supervisor: crash-safe stepping with bounded-backoff recovery
+and permanent-failure escalation (ISSUE 11).
+
+The Engine owns the recovery MECHANISM (poison detection, device-state
+rebuild, victim re-admission — engine.recover()); this module owns the
+POLICY: *when* to recover, how hard to back off, and when to stop
+trying.  ``EngineSupervisor.step()`` is a drop-in replacement for
+``Engine.step()`` — http.EngineLoop, bench.py and the tests drive it
+exactly like the engine — that turns three failure classes into
+self-healing instead of a dead process:
+
+  poisoned step     the engine's in-program isfinite sentinel (or an
+                    injected fault) surfaced garbage tokens at the
+                    readback: quarantine + rebuild, KEEPING the KV pool
+                    and radix cache (a poisoned step only ever wrote
+                    its rows' private frontier blocks — the PR 9
+                    copy-on-write argument makes the cache provably
+                    clean, so every victim's resume is a prefix hit).
+  step exception    a dispatch crashed (device OOM, compile error,
+                    injected prefill_exc): donated buffers may be
+                    invalid, so the rebuild additionally FLUSHES the
+                    cache and re-materializes the pool arrays.
+  watchdog trip     stuck_slot / stalled_step — a wedge with no
+                    exception to catch: same quarantine + rebuild.
+
+Recovery attempts back off exponentially (base * 2^(n-1), capped), and
+``max_consecutive`` failures inside ``settle_s`` escalate to PERMANENT
+failure: the engine drains cleanly (every in-flight/queued request gets
+a terminal ``failed`` Result with partial tokens salvaged, submissions
+refuse with EngineFailedError -> HTTP 503) instead of crash-looping
+through the same poison forever.  A clean stretch of ``settle_s``
+resets the consecutive counter, so a fault tomorrow starts the ladder
+from the bottom.
+
+No jax import — policy is host-side arithmetic (the obs/ contract);
+metrics publish on the engine's registry so /metrics carries
+``serve_engine_recoveries_total`` next to the latency it explains.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+# Watchdog kinds the supervisor treats as "the engine is wedged, a
+# rebuild can help" — the observability-only kinds (ttft_spike,
+# pool_thrash, admission_stall, post_freeze_retrace) page, they do not
+# trigger recovery: tearing down device state cannot un-spike a TTFT.
+RECOVERABLE_TRIPS = ("stuck_slot", "stalled_step")
+
+
+class EngineSupervisor:
+    """Crash-safe wrapper: ``step()`` like an Engine, plus quarantine /
+    rebuild / backoff / permanent-failure policy.
+
+    Parameters
+    ----------
+    engine : the Engine to supervise (metrics land on its registry).
+    max_consecutive : recoveries tolerated without a ``settle_s`` clean
+        stretch before escalating to permanent failure.
+    backoff_base_s / backoff_max_s : exponential backoff between a
+        detection and its rebuild (base * 2^(n-1), capped). Tests pass
+        base 0 to run the ladder without sleeping.
+    settle_s : a fault-free stretch this long resets the consecutive
+        counter (transient storms escalate; isolated blips do not).
+    sleep : injectable clock for tests.
+    """
+
+    def __init__(self, engine, *, max_consecutive: int = 4,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 5.0,
+                 settle_s: float = 60.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.engine = engine
+        self.max_consecutive = int(max_consecutive)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.settle_s = float(settle_s)
+        self._sleep = sleep
+        self.state = "ok"                   # ok | failed
+        self.recoveries = 0
+        self.consecutive = 0
+        self.last_cause: Optional[str] = None
+        self.last_detail = ""
+        self.last_backoff_s = 0.0
+        self._last_fault_t: Optional[float] = None
+        self._trip_mark = {k: engine.watchdog.trips.get(k, 0)
+                           for k in RECOVERABLE_TRIPS}
+        # Time-to-first-retired-token after a quarantine: the number an
+        # operator actually feels (rebuild time is host bookkeeping;
+        # TTFRT includes the re-prefill of every victim).
+        self._await_tok_t: Optional[float] = None
+        self._tok_mark = 0
+        m = engine.metrics
+        self._h_ttfrt = m.histogram(
+            "serve_recovery_ttfrt_seconds",
+            "Quarantine detection -> first post-recovery retired token.",
+            unit="seconds",
+            buckets=(0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0))
+        self._g_state = m.gauge(
+            "serve_supervisor_state",
+            "Supervisor state one-hot (ok | failed).",
+            labelnames=("state",))
+        self._g_state.labels(state="ok").set(1.0)
+
+    # ------------------------------------------------------------- step
+    def step(self) -> List:
+        """One supervised engine step. Returns the engine's finished
+        Results; on a detected fault, recovery happens HERE (quarantine
+        -> backoff -> rebuild -> requeue) and the re-admitted requests
+        finish through later steps. After permanent failure this only
+        flushes results the engine already owed."""
+        eng = self.engine
+        if self.state == "failed":
+            return eng.step()       # flushes pending results only
+        try:
+            results = eng.step()
+        except Exception as e:      # dispatch crash: buffers suspect
+            return self._handle_fault(
+                f"step_error:{type(e).__name__}", flush_cache=True,
+                detail=str(e))
+        cause = None
+        poison = eng.take_poison()
+        if poison is not None:
+            cause = poison.get("kind", "poisoned_step")
+        else:
+            cause = self._watchdog_cause()
+        if cause is not None:
+            results = list(results)
+            results.extend(self._handle_fault(cause, flush_cache=False))
+            return results
+        now = time.monotonic()
+        if (self._await_tok_t is not None
+                and eng.tokens_generated > self._tok_mark):
+            self._h_ttfrt.observe(now - self._await_tok_t)
+            self._await_tok_t = None
+        if (self.consecutive and self._last_fault_t is not None
+                and now - self._last_fault_t > self.settle_s):
+            self.consecutive = 0
+        return results
+
+    def drain(self) -> List:
+        """step() until idle — the supervised twin of Engine.drain()."""
+        out: List = []
+        while self.engine.has_work() and self.state != "failed":
+            out.extend(self.step())
+        out.extend(self.engine.step())      # flush any stragglers
+        return out
+
+    # ----------------------------------------------------------- policy
+    def _watchdog_cause(self) -> Optional[str]:
+        trips = self.engine.watchdog.trips
+        for kind, seen in self._trip_mark.items():
+            cur = trips.get(kind, 0)
+            if cur > seen:
+                self._trip_mark[kind] = cur
+                return kind
+        return None
+
+    def _handle_fault(self, cause: str, *, flush_cache: bool,
+                      detail: str = "") -> List:
+        eng = self.engine
+        now = time.monotonic()
+        if (self._last_fault_t is not None
+                and now - self._last_fault_t > self.settle_s):
+            self.consecutive = 0
+        self._last_fault_t = now
+        self.consecutive += 1
+        self.last_cause = cause
+        self.last_detail = detail
+        eng.quarantine(cause)
+        if self.consecutive > self.max_consecutive:
+            # Escalate: recovery is not converging — drain cleanly
+            # (terminal 'failed' Results, submissions refused) instead
+            # of burning the ladder forever on the same poison.
+            self.state = "failed"
+            self._g_state.labels(state="ok").set(0.0)
+            self._g_state.labels(state="failed").set(1.0)
+            return eng.abort_all(
+                f"{cause} x{self.consecutive} (recovery exhausted)")
+        backoff = min(self.backoff_base_s * (2 ** (self.consecutive - 1)),
+                      self.backoff_max_s)
+        self.last_backoff_s = backoff
+        if backoff > 0:
+            self._sleep(backoff)
+        self._tok_mark = eng.tokens_generated
+        self._await_tok_t = now
+        eng.recover(cause, flush_cache=flush_cache)
+        self.recoveries += 1
+        return []
+
+    # ------------------------------------------------------------ views
+    def stats(self) -> dict:
+        return {"state": self.state,
+                "recoveries": self.recoveries,
+                "consecutive": self.consecutive,
+                "max_consecutive": self.max_consecutive,
+                "last_cause": self.last_cause,
+                "last_detail": self.last_detail,
+                "last_backoff_s": self.last_backoff_s,
+                "ttfrt_s": self._h_ttfrt.percentiles((50, 90, 99))}

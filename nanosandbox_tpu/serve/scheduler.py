@@ -182,6 +182,14 @@ class SlotScheduler:
         self._queue = kept
         return shed
 
+    def requeue_front(self, items: List) -> None:
+        """Push recovered in-flight requests back at the HEAD of the
+        queue, preserving the given (original-admission) order — the
+        crash-recovery re-admission path: victims must not queue behind
+        traffic that arrived after them, or a recovery inverts FIFO
+        and a deadline-carrying victim starves into a shed."""
+        self._queue.extendleft(reversed(list(items)))
+
     def queued_items(self) -> List:
         """Snapshot of the queue, head first (the /debug/scheduler
         view; callers must not mutate the items)."""

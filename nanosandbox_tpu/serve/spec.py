@@ -231,6 +231,14 @@ class SpecRunner:
         # exact-match/argmax semantics via the per-row masks).
         a, out = lax.cond(jnp.any(t > 0.0), _sampled_path, _greedy_path,
                           None)
+        # Poison sentinel (engine._poison_guard's verify twin): a row
+        # whose logits went non-finite anywhere in its verify block
+        # would otherwise emit a plausible token (argmax over NaN is 0)
+        # and silently poison its KV history — map its fresh token to
+        # the out-of-vocab sentinel the engine's retire loop already
+        # checks for, at zero extra readback.
+        ok = jnp.isfinite(logits).all(axis=(1, 2))
+        out = jnp.where(ok, out, jnp.int32(V))
 
         active = state["active"]
         live = active.astype(jnp.int32)

@@ -174,12 +174,16 @@ def main() -> int:
 
         # Flight-recorder surface (ISSUE 10): the rid's lifecycle track
         # as JSON, the JSONL dump schema-validated, and a terminal
-        # `finish` exactly once.
+        # `finish` exactly once. The HTTP layer appends the returned
+        # status AFTER the terminal (ISSUE 11 status hygiene) — the
+        # client's 200 next to the engine's finish.
         track = json.loads(get(f"/debug/requests?rid={rid}"))["events"]
         evs = [e["ev"] for e in track]
-        assert evs[0] == "submit" and evs[-1] == "finish", evs
+        assert evs[0] == "submit" and evs[-1] == "http", evs
+        assert track[-1]["status"] == 200, track[-1]
         assert "admit" in evs and "prefill" in evs, evs
         assert evs.count("finish") == 1, evs
+        assert evs.index("finish") == len(evs) - 2, evs
         flight = validate_flight_jsonl(
             get("/debug/requests?format=jsonl").decode())
         assert any(e["ev"] == "finish" and e["rid"] == rid

@@ -1,0 +1,739 @@
+"""Fault injection, crash-safe recovery and graceful degradation tests.
+
+The contract under test (ISSUE 11):
+  * FaultPlan is deterministic: same plan + same workload -> same
+    failure at the same site, every run; plans parse from the flag
+    syntax and canned names; zero cost / zero compile-set change when
+    no plan is attached (pinned against a never-firing plan).
+  * Recovery correctness: a fault injected mid-decode on a mixed greedy
+    batch quarantines, rebuilds device state, re-admits every in-flight
+    request through the normal admission path, and the recovered engine
+    finishes ALL of them with outputs token-identical to a no-fault run
+    (row keys derive from fold_in(seed, absolute position), so the
+    resumed stream continues exactly where the fault cut it) — paged
+    AND dense, poison path AND exception path.
+  * Exactly-once terminals: a request admitted, interrupted, re-admitted
+    and finished emits exactly one terminal flight event and zero
+    orphaned evicts (fuzzed across spec/paged/dense mixes).
+  * Graceful degradation: drafter faults degrade a step to plain decode
+    and a streak disables spec (outputs unchanged); allocation failures
+    are backpressure, not crashes; permanent failure drains cleanly
+    (terminal 'failed' Results with salvaged partial tokens,
+    submissions refused) instead of crash-looping.
+  * Watchdog dump race regression: concurrent trips of different kinds
+    serialize and write kind-suffixed files.
+  * HTTP status hygiene: shed -> 429 + Retry-After; drain/quarantine ->
+    503; readiness flips on drain; flight records the returned status.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT
+from nanosandbox_tpu.obs import TERMINAL_EVENTS, render_prometheus
+from nanosandbox_tpu.serve import (Engine, EngineFailedError,
+                                   EngineSupervisor, FaultInjected,
+                                   FaultPlan, NGramDrafter, SlotScheduler)
+from nanosandbox_tpu.utils import tracecheck as _tracecheck
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _mixed_workload(eng, vocab, n=6, seed=3, budget=None, eos_id=None):
+    """Deterministic greedy mix: varied prompt lengths and budgets, the
+    same stream for every engine fed the same seed."""
+    rng = np.random.default_rng(seed)
+    rids = []
+    for i in range(n):
+        L = int(rng.integers(1, 24))
+        mnt = budget if budget is not None else int(rng.integers(4, 10))
+        kw = {}
+        if eos_id is not None and i % 3 == 0:
+            kw["eos_id"] = eos_id
+        rids.append(eng.submit(rng.integers(0, vocab, L).tolist(), mnt,
+                               **kw))
+    return rids
+
+
+def _drive(sup, limit=5000):
+    """Run a supervised engine to idle, collecting results by rid."""
+    got = {}
+    n = 0
+    while sup.engine.has_work() and n < limit:
+        for r in sup.step():
+            got[r.rid] = r
+        n += 1
+    assert n < limit, "supervised engine failed to drain"
+    return got
+
+
+# ------------------------------------------------------------ fault plan
+
+def test_fault_plan_parse_fire_and_rearm():
+    plan = FaultPlan.parse("nan_logits@4x2,slow_step@10:0.25,"
+                           "alloc_fail@0x3")
+    # before step 4: nothing fires at the nan site
+    assert plan.fire("nan_logits", 3) is None
+    f = plan.fire("nan_logits", 4)
+    assert f is not None and f.site == "nan_logits"
+    assert plan.fire("nan_logits", 5) is not None   # count=2
+    assert plan.fire("nan_logits", 6) is None       # drained
+    # count-based firing drains even with a frozen step counter (an
+    # admission stall dispatches nothing, steps never advance)
+    assert sum(plan.fire("alloc_fail", 0) is not None
+               for _ in range(5)) == 3
+    s = plan.fire("slow_step", 10)
+    assert s is not None and s.stall_s == 0.25
+    assert len(plan.fired_log) == 6
+    # rearm: firing state resets, steps re-base
+    plan.rearm(100)
+    assert plan.fire("nan_logits", 100) is None     # rel 0 < 4
+    assert plan.fire("nan_logits", 104) is not None
+    # canned names expand; unknown sites refuse
+    assert FaultPlan.parse("chaos-smoke").describe()
+    with pytest.raises(ValueError):
+        FaultPlan.parse("warp_core_breach@3")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("nan_logits")
+
+
+def test_fault_plan_probabilistic_is_deterministic():
+    a = FaultPlan.parse("drafter_fault@p0.3", seed=7)
+    b = FaultPlan.parse("drafter_fault@p0.3", seed=7)
+    fa = [a.fire("drafter_fault", i) is not None for i in range(50)]
+    fb = [b.fire("drafter_fault", i) is not None for i in range(50)]
+    assert fa == fb
+    # "each visit flips the coin": multiple fires across visits (a
+    # count=1 default would stop after the first hit), and both
+    # outcomes occur
+    assert 1 < sum(fa) < 50
+
+
+def test_disabled_plan_never_fires():
+    plan = FaultPlan.parse("nan_logits@0x99")
+    plan.enabled = False
+    assert plan.fire("nan_logits", 10) is None
+    assert plan.fired_log == []
+
+
+# --------------------------------------------------- recovery correctness
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_poisoned_step_recovery_token_identical(served_model, paged):
+    """THE acceptance pin: a fault mid-decode on a mixed greedy batch ->
+    quarantine, rebuild, re-admit; every request finishes with outputs
+    token-identical to a no-fault run, and the recovery metrics appear
+    on the engine registry (/metrics)."""
+    cfg, model, params = served_model
+
+    def build(faults=None):
+        return Engine(model, params, num_slots=4, max_len=64,
+                      paged=paged, faults=faults)
+
+    clean = build()
+    _mixed_workload(clean, cfg.vocab_size)
+    want = {r.rid: (r.prompt, r.tokens, r.finish_reason)
+            for r in clean.drain()}
+
+    plan = FaultPlan.parse("nan_logits@4")
+    eng = build(faults=plan)
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    _mixed_workload(eng, cfg.vocab_size)
+    got = {rid: (r.prompt, r.tokens, r.finish_reason)
+           for rid, r in _drive(sup).items()}
+    assert plan.fired_log, "fault never fired — the pin tested nothing"
+    assert eng.recoveries >= 1 and sup.recoveries >= 1
+    assert got == want
+    assert not eng.quarantined and sup.state == "ok"
+    text = render_prometheus(eng.metrics)
+    assert 'serve_engine_recoveries_total{cause="poisoned_step"} 1' \
+        in text
+    assert "serve_engine_recovery_seconds" in text
+    assert "serve_recovery_ttfrt_seconds" in text
+    assert eng.stats()["recovery"]["recoveries"] == eng.recoveries
+
+
+def test_prefill_exception_recovery_flushes_and_matches(served_model):
+    """A dispatch crash mid-admission (blocks committed, wave in limbo)
+    recovers on the exception path — cache flushed, pool rebuilt — and
+    still finishes everything token-identically."""
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size, n=10)
+    want = {r.rid: (r.tokens, r.finish_reason) for r in clean.drain()}
+
+    plan = FaultPlan.parse("prefill_exc@2")
+    eng = Engine(model, params, num_slots=4, max_len=64, faults=plan)
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    _mixed_workload(eng, cfg.vocab_size, n=10)
+    got = {rid: (r.tokens, r.finish_reason)
+           for rid, r in _drive(sup).items()}
+    assert plan.fired_log and eng.recoveries >= 1
+    assert got == want
+    # the block pool survived the unwind intact
+    eng.block_pool.check([st.alloc for st in eng._active.values()
+                          if st.alloc is not None])
+
+
+def test_scatter_corrupt_detected_at_wave_readback(served_model):
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size)
+    want = {r.rid: r.tokens for r in clean.drain()}
+    plan = FaultPlan.parse("scatter_corrupt@1")
+    eng = Engine(model, params, num_slots=4, max_len=64, faults=plan)
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    _mixed_workload(eng, cfg.vocab_size)
+    got = {rid: r.tokens for rid, r in _drive(sup).items()}
+    assert plan.fired_log and eng.recoveries >= 1
+    assert got == want
+
+
+def test_stalled_step_watchdog_triggers_recovery(served_model, tmp_path):
+    """A slow (stalled) decode step trips the stalled_step watchdog and
+    the supervisor treats it as recoverable — the no-exception wedge
+    class."""
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size)
+    want = [r.tokens for r in sorted(clean.drain(), key=lambda r: r.rid)]
+    plan = FaultPlan.parse("slow_step@3:0.12")
+    plan.enabled = False
+    eng = Engine(model, params, num_slots=4, max_len=64, faults=plan,
+                 watchdog_dir=str(tmp_path))
+    eng.watchdog.stalled_step_s = 0.05
+    # Warm the compile set first: a step that COMPILES is legitimately
+    # slow and deliberately does NOT feed the stalled_step detector, so
+    # the stall must be injected into a steady-state step.
+    _mixed_workload(eng, cfg.vocab_size)
+    eng.drain()
+    eng.reset_prefix_cache()      # cold cache: run 2 sees run 1's shapes
+    plan.rearm(eng.steps)
+    plan.enabled = True
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    _mixed_workload(eng, cfg.vocab_size)
+    got = [r.tokens for r in
+           sorted(_drive(sup).values(), key=lambda r: r.rid)]
+    assert plan.fired_log
+    assert eng.watchdog.trips.get("stalled_step", 0) >= 1
+    assert eng.recoveries >= 1
+    assert got == want
+
+
+def test_double_fault_resume_stitches_once(served_model):
+    """TWO faults interrupting the same requests still yield one
+    terminal each and token-identical stitched outputs (the _Resume
+    record accumulates across recoveries)."""
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size, budget=16)
+    want = {r.rid: (r.prompt, r.tokens) for r in clean.drain()}
+    plan = FaultPlan.parse("nan_logits@3,nan_logits@9")
+    eng = Engine(model, params, num_slots=4, max_len=64, faults=plan)
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    _mixed_workload(eng, cfg.vocab_size, budget=16)
+    got = {rid: (r.prompt, r.tokens) for rid, r in _drive(sup).items()}
+    assert len(plan.fired_log) == 2 and eng.recoveries == 2
+    assert got == want
+    for rid in got:
+        assert eng.flight.terminals(rid) == ["finish"]
+
+
+def test_requeued_victim_shed_unstitches_and_does_not_leak(served_model):
+    """Regression: a recovery-requeued victim whose deadline expires
+    before re-admission must shed with the ORIGINAL prompt, the
+    salvaged pre-fault tokens, one terminal, and no leaked _Resume
+    record."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    prompt = [3, 4, 5]
+    rid = eng.submit(prompt, 12, deadline_s=0.2)
+    for _ in range(4):
+        eng.step()
+    pre = list(next(iter(eng._active.values())).tokens)
+    assert pre, "victim never generated — scenario broken"
+    eng.quarantine("poisoned_step")
+    eng.recover("poisoned_step")
+    assert rid in eng._resumed
+    time.sleep(0.25)                     # deadline expires in the queue
+    results = eng.step()
+    assert [r.rid for r in results] == [rid]
+    r = results[0]
+    assert r.finish_reason == "shed"
+    assert r.prompt == tuple(prompt)     # NOT prompt + generated tokens
+    assert r.tokens == pre               # salvaged partial output
+    assert eng._resumed == {}            # no leak
+    assert eng.flight.terminals(rid) == ["shed"]
+
+
+def test_recover_handles_active_admitting_overlap(served_model):
+    """Regression: a crash INSIDE the wave-commit loop leaves a request
+    in BOTH _active and _admitting; recover() must release its slot and
+    blocks exactly once (a double release used to crash the recovery
+    itself) and the victim still finishes normally."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    rid = eng.submit([1, 2, 3], 6)
+    eng.step()                                 # admitted into a slot
+    st = next(iter(eng._active.values()))
+    eng._admitting = [(st.req, st.slot, st.alloc)]   # the crash window
+    eng.quarantine("test_overlap")
+    eng.recover("test_overlap")                # must not raise
+    eng.block_pool.check([])
+    results = eng.drain()
+    assert [(r.rid, r.finish_reason) for r in results] == [(rid, "length")]
+    assert len(results[0].tokens) == 6
+    assert eng.flight.terminals(rid) == ["finish"]
+
+
+# ------------------------------------------------ exactly-once terminals
+
+def test_exactly_once_terminals_under_recovery_fuzz(served_model):
+    """Fuzz the no-orphan contract across paged/dense/spec mixes with
+    faults landing mid-flight: every request reaches EXACTLY one
+    terminal, and no evict is orphaned (every evicted rid finishes,
+    exactly once — interrupted requests are requeued, not evicted)."""
+    cfg, model, params = served_model
+    cases = [
+        dict(paged=True),
+        dict(paged=False),
+        dict(paged=True, spec=NGramDrafter(k=3)),
+    ]
+    for i, case in enumerate(cases):
+        plan = FaultPlan.parse("nan_logits@3,prefill_exc@9,nan_logits@15")
+        eng = Engine(model, params, num_slots=4, max_len=64,
+                     faults=plan, **case)
+        sup = EngineSupervisor(eng, backoff_base_s=0.0)
+        rids = _mixed_workload(eng, cfg.vocab_size, n=10, seed=20 + i,
+                               eos_id=1)
+        rids.append(eng.submit([2, 3], 0))          # zero-token terminal
+        got = _drive(sup)
+        assert plan.fired_log, case
+        events = eng.flight.events()
+        for rid in rids:
+            terms = [e for e in events if e.get("rid") == rid
+                     and e["ev"] in TERMINAL_EVENTS]
+            assert len(terms) == 1, (case, rid, terms)
+            evicts = [e for e in events if e.get("rid") == rid
+                      and e["ev"] == "evict"]
+            assert len(evicts) <= 1, (case, rid)
+            if evicts:
+                assert terms[0]["ev"] == "finish", (case, rid)
+        assert set(got) == set(rids)
+
+
+# --------------------------------------------------- graceful degradation
+
+def test_drafter_fault_streak_disables_spec_not_engine(served_model):
+    """Drafter faults degrade the step to plain decode; a streak
+    disables spec for good — outputs stay token-identical to the
+    non-spec engine throughout (greedy spec == greedy non-spec is the
+    existing invariant)."""
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size, budget=20)
+    want = {r.rid: r.tokens for r in clean.drain()}
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 spec=NGramDrafter(k=3),
+                 faults=FaultPlan.parse("drafter_fault@2x99"),
+                 spec_fault_tolerance=3)
+    _mixed_workload(eng, cfg.vocab_size, budget=20)
+    got = {r.rid: r.tokens for r in eng.drain()}
+    assert got == want
+    assert eng.drafter_faults == 3           # disabled after tolerance
+    assert eng.spec_disabled_reason is not None
+    assert eng._spec is None
+    assert eng.stats()["recovery"]["spec_disabled"] is not None
+    assert any(e["ev"] == "spec_disabled" for e in eng.flight.events())
+
+
+def test_transient_drafter_fault_only_degrades_one_step(served_model):
+    """A single drafter blip below the tolerance keeps spec ENABLED
+    (the streak resets on the next healthy draft)."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 spec=NGramDrafter(k=3),
+                 faults=FaultPlan.parse("drafter_fault@2"),
+                 spec_fault_tolerance=3)
+    _mixed_workload(eng, cfg.vocab_size, budget=20)
+    eng.drain()
+    assert eng.drafter_faults == 1
+    assert eng.spec_disabled_reason is None and eng._spec is not None
+
+
+def test_alloc_fail_is_backpressure_not_a_crash(served_model):
+    cfg, model, params = served_model
+    clean = Engine(model, params, num_slots=4, max_len=64)
+    _mixed_workload(clean, cfg.vocab_size)
+    want = {r.rid: r.tokens for r in clean.drain()}
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 faults=FaultPlan.parse("alloc_fail@0x12"))
+    _mixed_workload(eng, cfg.vocab_size)
+    got = {r.rid: r.tokens for r in eng.drain()}
+    assert got == want
+    assert eng.block_pool.stall_steps >= 12
+    assert eng.recoveries == 0               # no rebuild needed
+
+
+def test_permanent_failure_drains_cleanly(served_model):
+    """Recovery that never converges escalates: terminal 'failed'
+    Results with salvaged partial tokens, exactly one terminal per rid,
+    submissions refused with EngineFailedError — no crash loop."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 faults=FaultPlan.parse("nan_logits@0x99"))
+    sup = EngineSupervisor(eng, max_consecutive=2, backoff_base_s=0.0)
+    rids = _mixed_workload(eng, cfg.vocab_size, n=8)
+    results = []
+    for _ in range(500):
+        results.extend(sup.step())
+        if sup.state == "failed" and not eng.has_work():
+            break
+    assert sup.state == "failed" and eng.failed
+    assert sorted(r.rid for r in results) == sorted(rids)
+    by_rid = {r.rid: r for r in results}
+    for rid in rids:
+        assert by_rid[rid].finish_reason == "failed"
+        terms = eng.flight.terminals(rid)
+        assert terms == ["failed"], (rid, terms)
+    # partial output salvaged: the admitted wave kept its pre-failure
+    # tokens (still-queued victims legitimately drain with none)
+    assert any(len(r.tokens) >= 1 for r in results)
+    with pytest.raises(EngineFailedError):
+        eng.submit([1, 2], 3)
+    assert eng.rejected.get("engine_failed") == 1
+    # a failed supervisor keeps flushing pending results, never raises
+    assert sup.step() == []
+    text = render_prometheus(eng.metrics)
+    assert 'serve_supervisor_state{state="failed"} 1' in text
+
+
+@pytest.mark.parametrize("spec", [False, True])
+def test_real_nan_logits_detected_in_program(served_model, spec):
+    """The in-program isfinite sentinel catches REAL non-finite logits
+    (not just injected poison) in both the decode/prefill samplers and
+    the spec verify: with NaN-poisoned params nothing plausible is ever
+    emitted — rows terminate 'failed' via the strike backstop instead
+    of silently returning argmax-over-NaN garbage."""
+    cfg, model, params = served_model
+    bad = jax.tree_util.tree_map(
+        lambda x: (x * jnp.nan).astype(x.dtype), params)
+    eng = Engine(model, bad, num_slots=2, max_len=64,
+                 spec=NGramDrafter(k=3) if spec else None)
+    rid = eng.submit([1, 2, 3], 6)
+    results = eng.drain()                 # terminates via the backstop
+    assert [r.rid for r in results] == [rid]
+    assert results[0].finish_reason == "failed"
+    assert results[0].tokens == []        # no garbage ever surfaced
+    assert eng.poisoned_steps >= 1
+    assert eng.flight.terminals(rid) == ["failed"]
+
+
+def test_unsupervised_persistent_poison_fails_rows_not_wedges(
+        served_model):
+    """Liveness backstop: WITHOUT a supervisor, persistently poisoned
+    rows terminate 'failed' after POISON_STRIKE_LIMIT strikes (clean
+    tokens salvaged, slot freed, one terminal) — drain() returns
+    instead of wedging the slot forever."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=4, max_len=64,
+                 faults=FaultPlan.parse("nan_logits@0x99999"))
+    rids = _mixed_workload(eng, cfg.vocab_size, n=6)
+    results = eng.drain()                 # must terminate
+    assert sorted(r.rid for r in results) == sorted(rids)
+    for r in results:
+        assert r.finish_reason == "failed"
+        assert len(r.tokens) >= 1         # the clean prefill token
+        assert eng.flight.terminals(r.rid) == ["failed"]
+    assert eng.sched.free_slots == eng.num_slots   # nothing leaked
+    assert not eng.failed                 # rows failed, engine did not
+
+
+def test_supervisor_backoff_ladder_and_settle(served_model):
+    """Backoff doubles per consecutive recovery (capped) and a clean
+    settle window resets the ladder."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    sleeps = []
+    sup = EngineSupervisor(eng, backoff_base_s=0.1, backoff_max_s=0.5,
+                           settle_s=0.05, sleep=sleeps.append)
+    for expect in (0.1, 0.2, 0.4, 0.5):
+        sup._last_fault_t = time.monotonic()  # inside the settle window
+        sup._handle_fault("poisoned_step", flush_cache=False)
+        assert sleeps[-1] == pytest.approx(expect)
+    # a quiet stretch longer than settle_s resets the ladder
+    sup._last_fault_t = time.monotonic() - 1.0
+    sup._handle_fault("poisoned_step", flush_cache=False)
+    assert sleeps[-1] == pytest.approx(0.1)
+
+
+# ------------------------------------------------ budgets stay untouched
+
+def test_compile_set_and_sync_ledger_unchanged_by_fault_hooks(
+        served_model):
+    """ISSUE-11 acceptance: with faults disabled (no plan, or a plan
+    that never fires) the compile set and the audited host-sync ledger
+    are IDENTICAL to a plain engine's — the hooks are pure host-side
+    branches."""
+    cfg, model, params = served_model
+
+    def run(**kw):
+        mark = _tracecheck.sync_counts()
+        eng = Engine(model, params, num_slots=2, max_len=64, **kw)
+        for i in range(4):
+            eng.submit([1 + i, 2], 5)
+        eng.drain()
+        return (eng.max_programs(), dict(eng.trace_counts),
+                _tracecheck.sync_delta(mark))
+
+    plain = run()
+    armed = run(faults=FaultPlan.parse("nan_logits@100000"))
+    assert plain == armed
+
+
+# ---------------------------------------------- watchdog dump race (fix)
+
+def test_watchdog_dump_serialized_and_kind_suffixed(served_model,
+                                                    tmp_path):
+    """Regression: concurrent trips of different kinds used to be able
+    to interleave writes into one snapshot. Dumps now serialize under a
+    lock and every file carries its trip kind — each dump dir holds
+    exactly its own three parseable files."""
+    _, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 watchdog_dir=str(tmp_path))
+    eng.submit([1, 2], 2)
+    eng.drain()
+    wd = eng.watchdog
+    wd.cooldown_s = 0.0                       # dump on every trip
+
+    def trip(kind):
+        for _ in range(4):
+            wd._trip(kind, {"forced": True})
+
+    threads = [threading.Thread(target=trip, args=(k,))
+               for k in ("ttft_spike", "stuck_slot", "stalled_step")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dirs = os.listdir(tmp_path)
+    assert len(dirs) == 12                    # one dir per dumped trip
+    for d in dirs:
+        kind = d.rsplit("-", 2)[0]
+        files = sorted(os.listdir(tmp_path / d))
+        assert files == sorted([f"flight-{kind}.jsonl",
+                                f"meta-{kind}.json",
+                                f"trace-{kind}.json"]), (d, files)
+        with open(tmp_path / d / f"meta-{kind}.json") as f:
+            assert json.load(f)["trip"]["kind"] == kind
+        with open(tmp_path / d / f"trace-{kind}.json") as f:
+            assert "traceEvents" in json.load(f)
+        with open(tmp_path / d / f"flight-{kind}.jsonl") as f:
+            for ln in f:
+                json.loads(ln)
+    assert wd.dump_errors == 0
+
+
+# --------------------------------------------------------- scheduler unit
+
+def test_requeue_front_preserves_order():
+    class Item:
+        def __init__(self, rid, n):
+            self.rid, self.prompt = rid, (0,) * n
+
+    s = SlotScheduler(4, [16, 32])
+    s.enqueue(Item(10, 3))
+    s.enqueue(Item(11, 3))
+    s.requeue_front([Item(1, 3), Item(2, 3), Item(3, 3)])
+    assert [it.rid for it in s.queued_items()] == [1, 2, 3, 10, 11]
+
+
+# ------------------------------------------------------ HTTP status layer
+
+def _start_server(eng, supervisor=None):
+    from nanosandbox_tpu.serve.http import EngineLoop, make_server
+
+    loop = EngineLoop(eng, supervisor=supervisor)
+    loop.start()
+    encode = lambda s: [min(ord(c), 49) for c in s]       # noqa: E731
+    decode = lambda ids: " ".join(str(i) for i in ids)    # noqa: E731
+    srv = make_server("127.0.0.1", 0, loop, encode, decode)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, loop, srv.server_address[1]
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode())
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def test_http_drain_readiness_and_status_hygiene(served_model):
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    sup = EngineSupervisor(eng)
+    srv, loop, port = _start_server(eng, supervisor=sup)
+    try:
+        # healthy: liveness AND readiness green, liveness shape frozen
+        assert _get(port, "/healthz")[1] == {"ok": True}
+        code, body = _get(port, "/healthz?ready=1")
+        assert code == 200 and body["ready"] is True
+        code, body, _ = _post(port, "/generate",
+                              {"prompt": "ab", "max_new_tokens": 3,
+                               "temperature": 0.0})
+        assert code == 200 and len(body["tokens"]) == 3
+        # drain: readiness flips red, liveness stays green, /generate
+        # gets 503 + Retry-After, the flight ledger records both codes
+        code, body, _ = _post(port, "/drain", {})
+        assert code == 200 and body["draining"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/generate", {"prompt": "ab",
+                                      "max_new_tokens": 2})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert "retry against another replica" in \
+            json.loads(ei.value.read())["error"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/healthz?ready=1")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["reason"] == "draining"
+        assert _get(port, "/healthz")[1] == {"ok": True}
+        # idempotent + reports drained once idle
+        code, body, _ = _post(port, "/drain", {})
+        assert body["drained"] is True
+        statuses = [e["status"] for e in eng.flight.events()
+                    if e["ev"] == "http"]
+        assert 200 in statuses and 503 in statuses
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+def test_http_shed_returns_429_with_retry_after(served_model):
+    """A queue-expired (shed) request returns 429 + Retry-After derived
+    from the queue-wait p50 — not a generic error, and not a 200."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=1, max_len=64)
+    srv, loop, port = _start_server(eng)
+    try:
+        out = {}
+
+        def blocker():
+            out["b"] = _post(port, "/generate",
+                             {"prompt": "ab", "max_new_tokens": 56,
+                              "temperature": 0.0})
+
+        def shed_client():
+            try:
+                out["s"] = _post(port, "/generate",
+                                 {"prompt": "cd", "max_new_tokens": 8,
+                                  "deadline_s": 0.01})
+            except urllib.error.HTTPError as e:
+                out["s"] = (e.code, json.loads(e.read()),
+                            dict(e.headers))
+
+        tb = threading.Thread(target=blocker)
+        tb.start()
+        time.sleep(0.25)          # blocker owns the only slot
+        ts = threading.Thread(target=shed_client)
+        ts.start()
+        tb.join(60)
+        ts.join(60)
+        code, body, headers = out["s"]
+        assert code == 429, out["s"]
+        assert body["finish_reason"] == "shed"
+        assert int(headers["Retry-After"]) >= 1
+        assert out["b"][0] == 200
+        assert 429 in [e["status"] for e in eng.flight.events()
+                       if e["ev"] == "http"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+def test_http_recovery_invisible_to_clients(served_model):
+    """Clients riding through a quarantine+recovery see only their
+    (token-identical) 200s — the loop never dies, waiters never fail."""
+    cfg, model, params = served_model
+    plan = FaultPlan.parse("nan_logits@4")
+    eng = Engine(model, params, num_slots=4, max_len=64, faults=plan)
+    sup = EngineSupervisor(eng, backoff_base_s=0.0)
+    srv, loop, port = _start_server(eng, supervisor=sup)
+    try:
+        out = {}
+
+        def client(i):
+            out[i] = _post(port, "/generate",
+                           {"prompt": "ab" * (i + 1),
+                            "max_new_tokens": 6, "temperature": 0.0})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert all(out[i][0] == 200 for i in range(5))
+        assert all(len(out[i][1]["tokens"]) == 6 for i in range(5))
+        assert eng.recoveries >= 1, plan.stats()
+        assert loop.dead is None
+        # recovery posture is visible in /stats
+        stats = _get(port, "/stats")[1]
+        assert stats["recovery"]["recoveries"] >= 1
+        assert stats["loop"]["supervisor"]["state"] == "ok"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        loop.stop()
+
+
+def test_bench_serve_fault_mode(served_model):
+    """bench.py --mode=serve --faults wires the chaos point end to end:
+    recoveries happen, the fault block lands in the JSON, the flight
+    JSONL dumps."""
+    import bench
+
+    out = bench.main(["--mode=serve", "--quick", "--num_slots=2",
+                      "--requests=6", "--load=1", "--burst=0",
+                      "--faults=nan_logits@2",
+                      "--flight_out=/tmp/test-fault-flight.jsonl"])
+    f = out["extra"]["fault"]
+    assert f["recoveries"] >= 1
+    assert f["supervisor_state"] == "ok"
+    assert f["goodput_under_fault_ratio"] is None \
+        or f["goodput_under_fault_ratio"] > 0
+    pt = out["extra"]["sweep"]["fault"]
+    assert pt["finished"] + pt["shed"] == pt["requests"]
+    with open("/tmp/test-fault-flight.jsonl") as fh:
+        evs = [json.loads(ln) for ln in fh]
+    assert any(e["ev"] == "recover" for e in evs)

@@ -340,12 +340,15 @@ def test_watchdog_ttft_spike_trips_and_dumps(served_model, tmp_path):
     assert 'watchdog_trips_total{kind="ttft_spike"} 1' in text
     dump = wd.last_trip["dump"]
     assert dump is not None and dump.startswith(str(tmp_path))
-    with open(os.path.join(dump, "flight.jsonl")) as f:
+    # Files carry the trip kind (the ISSUE-11 dump-race fix): two
+    # near-simultaneous trips of different kinds can never claim each
+    # other's snapshot files.
+    with open(os.path.join(dump, "flight-ttft_spike.jsonl")) as f:
         lines = [json.loads(ln) for ln in f]
     assert any(e["ev"] == "finish" for e in lines)
-    with open(os.path.join(dump, "trace.json")) as f:
+    with open(os.path.join(dump, "trace-ttft_spike.json")) as f:
         assert "traceEvents" in json.load(f)
-    with open(os.path.join(dump, "meta.json")) as f:
+    with open(os.path.join(dump, "meta-ttft_spike.json")) as f:
         meta = json.load(f)
     assert meta["trip"]["kind"] == "ttft_spike"
     # cooldown: an immediate second trip counts but does not re-dump

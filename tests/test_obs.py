@@ -838,7 +838,10 @@ def test_http_debug_endpoints_roundtrip(served_model):
         evs = json.loads(body)["events"]
         assert [e["ev"] for e in evs][:2] == ["submit", "queue"]
         assert evs[0]["slo_class"] == "interactive"
-        assert [e["ev"] for e in evs][-1] == "finish"
+        # The HTTP layer appends the returned status after the terminal
+        # (ISSUE 11 status hygiene): ... -> finish -> http{status=200}.
+        assert [e["ev"] for e in evs][-2:] == ["finish", "http"]
+        assert evs[-1]["status"] == 200
 
         body, ctype = get(f"/debug/requests?rid={rid}&format=jsonl")
         assert ctype == "application/x-ndjson"
