@@ -16,6 +16,8 @@ Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
        python bench.py --mode=decode [--quick] [--num_slots=N] \
            [--max_new_tokens=N] [--requests=N] [--mixed=1] \
            [--paged={on,off}] [--prefix_share=F] [--kv_page_size=N] \
+           [--scan_k=N] [--kv_dtype={fp32,bf16,int8,int4}] \
+           [--baseline_kv_dtype=MODE] [--decode_impl=IMPL] \
            [--spec={off,ngram}] [--spec_k=N] [--repetitive] [--repeat=N] \
            [--emit_obs]
        python bench.py --mode=serve [--quick] [--num_slots=N] \
@@ -168,7 +170,9 @@ def estimate_decode_hbm_bytes_per_token(cfg, *, num_slots: int,
     to 1-byte values. An estimate, not a measurement: it ignores
     activations (tiny at T=1) and assumes every slot is occupied."""
     head_dim = cfg.n_embd // cfg.n_head
-    if kv_dtype == "int8":
+    if kv_dtype == "int4":
+        val_bytes, scale_bytes = 0.5, 4      # two nibbles per byte
+    elif kv_dtype == "int8":
         val_bytes, scale_bytes = 1, 4
     elif kv_dtype in ("bf16", "bfloat16"):
         val_bytes, scale_bytes = 2, 0
@@ -277,6 +281,22 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         raise SystemExit(f"--spec={spec!r}: decode bench supports off|ngram")
     spec_k = int(kv.get("spec_k", 4))
     repetitive = _flag(kv, "repetitive")
+    # --scan_k=N: the primary engines dispatch multi-token scan chunks
+    # (serve/engine.py megaprogram ladder); a scan_k=1 pipelined twin
+    # rides the SAME interleaved rotated rounds so scan_vs_single_toks
+    # is attributable to the dispatch amortization alone, with greedy
+    # parity pinned at 1.0 and dispatches_per_token measured (the
+    # ISSUE-12 <= 0.15 bar).
+    scan_k = int(kv.get("scan_k", 1))
+    # int4-vs-int8 capacity twin: at equal VALUE bytes an int4 pool
+    # holds 2x the blocks of an int8 one, so when the baseline mode is
+    # int8 the primary int4 engines get a 2x-block pool — the
+    # effective_slot_capacity comparison then holds pool value-HBM
+    # constant, exactly like the paged-vs-dense capacity story.
+    slot_blocks = -(-max_len // kv_page)
+    pool_blocks_primary = None
+    if paged and kv_dtype == "int4" and baseline_mode == "int8":
+        pool_blocks_primary = 2 * num_slots * slot_blocks
 
     model = GPT(cfg)
     params = model.init(jax.random.key(0),
@@ -321,11 +341,12 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 prompt = shared_prefix + prompt[:tail]
             engine.submit(prompt, mnt)
 
-    def build(pipeline: bool, drafter=None, kvd=kv_dtype, pg=paged):
+    def build(pipeline: bool, drafter=None, kvd=kv_dtype, pg=paged,
+              sk=scan_k, impl=decode_impl, pool_blocks=None):
         engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
                         pipeline=pipeline, spec=drafter, kv_dtype=kvd,
-                        decode_impl=decode_impl, paged=pg,
-                        kv_page_size=kv_page)
+                        decode_impl=impl, paged=pg, scan_k=sk,
+                        kv_page_size=kv_page, kv_pool_blocks=pool_blocks)
         # Warmup: every (wave rung, bucket) prefill + admit + decode +
         # release program, so no timed window eats an XLA compile. The
         # prompt length must MAP to the bucket being warmed (in
@@ -345,6 +366,9 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 # NEXT wave's suffix bucket (the program it exists to
                 # compile) — same hygiene as serve __main__'s warmup.
                 engine.reset_prefix_cache()
+        # The scan-chunk rung ladder (scan_k > 1): compile every
+        # megaprogram up front — no timed round may eat a rung compile.
+        engine.warm_scan_rungs()
         # Warmup TTFT/TPOT samples would swamp the workload's in the
         # rings (45 warmup requests vs 16 timed at the defaults): the
         # reported percentiles must describe the measured traffic.
@@ -367,8 +391,28 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     # 50ms drain several-fold, so engine comparisons alternate rounds
     # (same per-round workload seed for every engine) and report the
     # median — the PR 2 measurement discipline, now built in.
+    def greedy_parity(rounds_a, rounds_b):
+        """Matched-token fraction between two engines' per-round token
+        lists (same workload seeds): the ONE definition every parity
+        field in this bench reports."""
+        total = matched = 0
+        for ra, rb in zip(rounds_a, rounds_b):
+            for ta, tb in zip(ra, rb):
+                total += max(len(ta), len(tb))
+                matched += sum(x == y for x, y in zip(ta, tb))
+        return matched / max(total, 1)
+
     repeat = int(kv.get("repeat", 1 if quick else 3))
-    engines = {"sync": build(pipeline=False), "pipe": build(pipeline=True)}
+    engines = {"sync": build(pipeline=False,
+                             pool_blocks=pool_blocks_primary),
+               "pipe": build(pipeline=True,
+                             pool_blocks=pool_blocks_primary)}
+    if scan_k > 1:
+        # The scan_k=1 pipelined twin: same pool layout/bytes, same
+        # workload seeds, same rotated rounds — the ratio isolates the
+        # dispatch amortization.
+        engines["scan1"] = build(pipeline=True, sk=1,
+                                 pool_blocks=pool_blocks_primary)
     if paged:
         # The dense-pool twin rides the SAME interleaved rounds and
         # workload seeds: paged_vs_dense_toks is then attributable to
@@ -388,6 +432,21 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     gen_total = {name: 0 for name in engines}
     dt_total = {name: 0.0 for name in engines}
     tokens_by_engine = {name: [] for name in engines}
+    # Dispatch-ledger marks at the end of warmup: the reported
+    # dispatches/token must describe the TIMED workload (warmup traffic
+    # is all tiny-budget rung-1 chunks, which would skew the ratio the
+    # ISSUE-12 <= 0.15 bar is judged on).
+    dispatch_marks = {
+        name: (e.host_dispatches["decode"] + e.host_dispatches["verify"],
+               e.tokens_generated)
+        for name, e in engines.items()}
+
+    def timed_dispatch_ratio(name):
+        e = engines[name]
+        d0, t0 = dispatch_marks[name]
+        d = e.host_dispatches["decode"] + e.host_dispatches["verify"] - d0
+        t = e.tokens_generated - t0
+        return (d / t if t else None), (t / d if d else None)
     names = list(engines)
     steady_mark = None
     for r in range(repeat):
@@ -414,6 +473,11 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
 
     engine = engines["pipe"]
     stats = engine.stats()
+    # Capture the timed-workload dispatch ratios NOW — the TTFT probes
+    # below submit extra requests that would re-contaminate the ledger.
+    pipe_dpt, pipe_tpd = timed_dispatch_ratio("pipe")
+    scan1_dpt = (timed_dispatch_ratio("scan1")[0]
+                 if "scan1" in engines else None)
     rate = median(rates["pipe"])
     generated, dt = gen_total["pipe"], dt_total["pipe"]
 
@@ -450,12 +514,6 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     if paged:
         pool_stats = engine.block_pool.stats()
         dense_rate = median(rates["dense"])
-        total = matched = 0
-        for ra, rb in zip(tokens_by_engine["pipe"],
-                          tokens_by_engine["dense"]):
-            for ta, tb in zip(ra, rb):
-                total += max(len(ta), len(tb))
-                matched += sum(x == y for x, y in zip(ta, tb))
         mean_priv = pool_stats["mean_private_blocks_per_request"]
         # Steady-state footprint: the final (cache-warm) round only —
         # what a long-running deployment's admission actually reserves.
@@ -473,7 +531,8 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "kv_pool_blocks": engine.kv_pool_blocks,
             "dense_tokens_per_sec": dense_rate,
             "paged_vs_dense_toks": rate / dense_rate,
-            "paged_greedy_parity": matched / max(total, 1),
+            "paged_greedy_parity": greedy_parity(
+                tokens_by_engine["pipe"], tokens_by_engine["dense"]),
             "prefix_hit_rate": pool_stats["prefix_hit_rate"],
             "prefix_hit_tokens": pool_stats["prefix_hit_tokens"],
             "prefix_miss_tokens": pool_stats["prefix_miss_tokens"],
@@ -509,22 +568,66 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 "hit_over_miss": (hit_p50 / miss_p50
                                   if hit_p50 and miss_p50 else None),
             }
+    # Multi-token scan signal (ISSUE 12): tokens/sec vs the scan_k=1
+    # twin, greedy parity (must be 1.0 — chunks are dispatch
+    # boundaries, not sampling state), and the dispatch-floor numbers
+    # (timed-workload deltas only — warmup traffic excluded).
+    scan_extra = {
+        "scan_k": scan_k,
+        "scan_rungs": list(engine.scan_rungs),
+        "dispatches_per_token": pipe_dpt,
+        "tokens_per_dispatch": pipe_tpd,
+    }
+    if scan_k > 1:
+        single_rate = median(rates["scan1"])
+        scan_extra.update({
+            "single_step_tokens_per_sec": single_rate,
+            "scan_vs_single_toks": rate / single_rate,
+            "scan_greedy_parity": greedy_parity(tokens_by_engine["pipe"],
+                                                tokens_by_engine["scan1"]),
+            "single_step_dispatches_per_token": scan1_dpt,
+        })
+
+    # Paged-prefill kernel vs the gathered XLA fallback, as an isolated
+    # single-request TTFT probe (throughput rounds bury prefill inside
+    # queueing): only meaningful when the primary engines actually run
+    # a kernel impl — on CPU that is interpret mode, a correctness
+    # surface whose ratio documents the interpreter tax, while on TPU
+    # the same field carries the real kernel-vs-gather TTFT cut.
+    if paged and engine.decode_impl != "xla":
+        xla_twin = build(pipeline=True, impl="xla",
+                         pool_blocks=pool_blocks_primary)
+        probe_len = max(2, max_prompt - 1)
+
+        def ttft_p50(e):
+            e.reset_latency_stats()
+            prng = np.random.default_rng(77)
+            for _ in range(3 if quick else 7):
+                e.submit(prng.integers(0, cfg.vocab_size,
+                                       probe_len).tolist(), 2)
+                e.drain()
+            p = e.stats()["ttft_s"]
+            return (p or {}).get("p50")
+
+        k_p50, x_p50 = ttft_p50(engine), ttft_p50(xla_twin)
+        scan_extra["paged_prefill_kernel_vs_xla_ttft"] = {
+            "kernel_impl": engine.decode_impl,
+            "kernel_p50_s": k_p50, "xla_p50_s": x_p50,
+            "kernel_over_xla": (k_p50 / x_p50
+                                if k_p50 and x_p50 else None),
+        }
+
     if compare_kv:
         base_rate = median(rates["kv_base"])
         # Greedy token parity vs the default-mode pipelined twin: same
         # workload seeds, deterministic engines, so the match fraction
         # is a pure function of the quantization drift.
-        total = matched = 0
-        for round_a, round_b in zip(tokens_by_engine["pipe"],
-                                    tokens_by_engine["kv_base"]):
-            for ta, tb in zip(round_a, round_b):
-                total += max(len(ta), len(tb))
-                matched += sum(x == y for x, y in zip(ta, tb))
         kv_extra.update({
             "baseline_kv_dtype": engines["kv_base"].kv_dtype,
             "baseline_tokens_per_sec": base_rate,
             "kv_vs_baseline": median(rates["pipe"]) / base_rate,
-            "kv_greedy_parity": matched / max(total, 1),
+            "kv_greedy_parity": greedy_parity(tokens_by_engine["pipe"],
+                                              tokens_by_engine["kv_base"]),
             "estimated_hbm_bytes_per_token_baseline":
                 estimate_decode_hbm_bytes_per_token(
                     cfg, num_slots=num_slots, mean_frontier=mean_frontier,
@@ -537,6 +640,27 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             # otherwise the honest keys are kv_vs_baseline +
             # baseline_kv_dtype.
             kv_extra["int8_vs_fp32"] = kv_extra["kv_vs_baseline"]
+        if kv_dtype == "int4" and baseline_mode == "int8":
+            kv_extra["int4_vs_int8_toks"] = kv_extra["kv_vs_baseline"]
+            if paged:
+                # Capacity at equal pool VALUE bytes: the primary int4
+                # engines run a 2x-block pool (pool_blocks_primary
+                # above), the int8 twin the default — block need per
+                # request is dtype-independent, so the measured
+                # effective-capacity ratio is the slot-capacity
+                # doubling int4 buys at constant value HBM.
+                # Lifetime means on BOTH sides (mean_priv is the
+                # primary's lifetime figure): mixing the primary's
+                # cache-warm steady window with the baseline's
+                # all-rounds mean would flatter the ratio.
+                bstats = engines["kv_base"].block_pool.stats()
+                bpriv = bstats["mean_private_blocks_per_request"]
+                cap4 = (engine.kv_pool_blocks / mean_priv
+                        if mean_priv else None)
+                cap_base = (engines["kv_base"].kv_pool_blocks / bpriv
+                            if bpriv else None)
+                kv_extra["int4_capacity_vs_int8_equal_value_bytes"] = (
+                    cap4 / cap_base if cap4 and cap_base else None)
 
     spec_extra = {"spec": spec}
     if spec != "off":
@@ -615,6 +739,7 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "tpot_s": stats["tpot_s"],
             "queue_wait_steps_mean": stats["queue_wait_steps_mean"],
             "repetitive": repetitive,
+            **scan_extra,
             **kv_extra,
             **paged_extra,
             **spec_extra,

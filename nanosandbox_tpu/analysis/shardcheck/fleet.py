@@ -93,7 +93,16 @@ def serve_programs(mesh) -> List:
     table gather/scatter partitions trivially under replication, the
     contract ROADMAP-1 TP serving must rewrite). A dense fp32 engine
     (no spec) keeps the pre-paged layout pinned under *_dense names —
-    the bench comparison baseline stays budgeted too."""
+    the bench comparison baseline stays budgeted too.
+
+    ISSUE 12 grows the fleet two ways: an int4-KV twin (*_kv4 —
+    packed-nibble pool, interpret-mode kernels, so the analyzed decode
+    AND paged-prefill programs contain the real unpack/fold ops) and
+    the multi-token scan megaprogram ladder (decode_scan2/decode_scan4
+    from a scan_k=4 engine — each rung is its own compiled surface the
+    budget must name; the scan engine's prefill/rung-1 programs are
+    identical to the default engine's and are filtered out rather than
+    double-pinned)."""
     import jax
     import jax.numpy as jnp
 
@@ -121,11 +130,20 @@ def serve_programs(mesh) -> List:
                         prefill_buckets=(16, 32),
                         spec=ModelDrafter(dmodel, dparams, k=3),
                         kv_dtype="int8", decode_impl="pallas_interpret")
+    engine_kv4 = Engine(model, params, num_slots=4, max_len=32,
+                        prefill_buckets=(16, 32),
+                        kv_dtype="int4", decode_impl="pallas_interpret")
     engine_dense = Engine(model, params, num_slots=4, max_len=32,
                           prefill_buckets=(16, 32), paged=False)
+    engine_scan = Engine(model, params, num_slots=4, max_len=32,
+                         prefill_buckets=(16, 32), scan_k=4)
+    scan_specs = [s for s in engine_scan.shardcheck_programs(mesh)
+                  if "decode_scan" in s.name]
     return (engine.shardcheck_programs(mesh)
             + engine_kv8.shardcheck_programs(mesh)
-            + engine_dense.shardcheck_programs(mesh))
+            + engine_kv4.shardcheck_programs(mesh)
+            + engine_dense.shardcheck_programs(mesh)
+            + scan_specs)
 
 
 def frontier_slice_programs(mesh, constrained: bool) -> List:

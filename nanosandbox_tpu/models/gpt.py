@@ -80,21 +80,30 @@ class CausalSelfAttention(nn.Module):
             from jax import lax
 
             from nanosandbox_tpu.ops.flash_decode import (
-                flash_decode, flash_decode_paged, quantize_kv_rows,
-                resolve_decode_impl)
+                flash_decode, flash_decode_paged, flash_prefill_paged,
+                quantize_kv_rows, quantize_kv_rows_int4,
+                resolve_decode_impl, unpack_int4,
+                xla_decode_attention_paged)
 
-            # int8 KV mode (init_cache kv_dtype='int8'): the layer cache
-            # is (K int8, V int8, k_scale f32, v_scale f32) with one
-            # scale per (row, head, position) — quantize-on-write, so
-            # quantized K/V is the only representation the pool holds.
+            # int8/int4 KV mode (init_cache kv_dtype=): the layer cache
+            # is (K, V, k_scale f32, v_scale f32) with one scale per
+            # (row, head, position) — quantize-on-write, so quantized
+            # K/V is the only representation the pool holds. int4 packs
+            # two nibbles per byte along head_dim (uint8 storage, the
+            # dtype that distinguishes the two modes).
             quantized = len(cache) == 4
+            four_bit = quantized and cache[0].dtype == jnp.uint8
+            _quantize = quantize_kv_rows_int4 if four_bit \
+                else quantize_kv_rows
             if quantized:
                 ck, cv, cks, cvs = cache
-                k_w, ks_w = quantize_kv_rows(k)      # (B, H, T, D)->(B,H,T)
-                v_w, vs_w = quantize_kv_rows(v)
             else:
                 ck, cv = cache
                 cks = cvs = None
+            if quantized and block_table is None:
+                k_w, ks_w = _quantize(k)         # (B, H, T, D')->(B,H,T)
+                v_w, vs_w = _quantize(v)
+            elif not quantized:
                 k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
             Tc = ck.shape[2]
             per_row = getattr(cache_index, "ndim", 0) == 1
@@ -126,10 +135,21 @@ class CausalSelfAttention(nn.Module):
                                           jnp.minimum(jblk, nb - 1), axis=1)
                 blk = jnp.where(jblk < nb, blk, n_blk)       # drop overruns
                 bf, of = blk.reshape(-1), (qpos % page).reshape(-1)
+                if quantized:
+                    # Quantize AFTER the drop mask is known: positions
+                    # destined for the sentinel block (ladder-padding
+                    # rows, parked tables, frontier overruns) skip the
+                    # amax/divide/round scale chain outright — that
+                    # work fed a write the scatter drops on the floor
+                    # anyway, a measurable lane-waste on every prefill
+                    # wave.
+                    w_valid = (blk < n_blk)[:, None, :]       # (B, 1, T)
+                    k_w, ks_w = _quantize(k, valid=w_valid)
+                    v_w, vs_w = _quantize(v, valid=w_valid)
 
                 def _scatter_vals(buf, x):
                     vals = x.transpose(0, 2, 1, 3).reshape(
-                        B * T, cfg.n_head, head_dim)
+                        B * T, cfg.n_head, x.shape[-1])
                     return buf.at[bf, :, of, :].set(vals, mode="drop")
 
                 ck = _scatter_vals(ck, k_w)
@@ -212,6 +232,32 @@ class CausalSelfAttention(nn.Module):
                         sm_scale=1.0 / head_dim ** 0.5,
                         interpret=(decode_impl == "pallas_interpret"))[
                             :, :, None, :]
+            elif per_row and T == 1 and block_table is not None:
+                # XLA fallback's paged DECODE fast path: masked
+                # attention contracted straight against the block-
+                # indexed (B, nb, H, page, D) gather — no chain
+                # relayout into contiguous rows, which was a full
+                # working-set transpose copy per layer per decode step
+                # (the measured paged-vs-dense CPU decode gap, and
+                # under scan_k it recurred every fused step).
+                y = xla_decode_attention_paged(
+                    q[:, :, 0, :], ck, cv, block_table, cache_index + 1,
+                    k_scale=cks, v_scale=cvs,
+                    sm_scale=1.0 / head_dim ** 0.5)[:, :, None, :]
+            elif (per_row and block_table is not None
+                  and decode_impl != "xla"):
+                # Paged prefill / verify (T > 1) flash kernel: each
+                # row's (T, D) suffix queries walk its block chain
+                # through the scalar-prefetched table — the resident
+                # prefix included — instead of the gathered-masked XLA
+                # fallback below, which copies every row's whole chain
+                # into contiguous rows per wave (the last non-kernel
+                # hot path, and the known paged-vs-dense CPU TTFT gap).
+                y = flash_prefill_paged(
+                    q, ck, cv, block_table, cache_index,
+                    k_scale=cks, v_scale=cvs,
+                    sm_scale=1.0 / head_dim ** 0.5,
+                    interpret=(decode_impl == "pallas_interpret"))
             else:
                 # Masked-score XLA path. When cache_index is a STATIC int
                 # (prefill / sample.generate's first pass) the attended
@@ -242,6 +288,11 @@ class CausalSelfAttention(nn.Module):
                     ck_a, cv_a = ck[:, :, :span], cv[:, :, :span]
                     cks_a = cks[:, :, :span] if quantized else None
                     cvs_a = cvs[:, :, :span] if quantized else None
+                if four_bit:
+                    # Packed int4 unpacks to int8 for the reference
+                    # math; scales then fold identically to int8 (the
+                    # kernels unpack per-tile in-register instead).
+                    ck_a, cv_a = unpack_int4(ck_a), unpack_int4(cv_a)
                 # (B|1, 1, T, span): kpos <= qpos. The unwritten/stale
                 # buffer tail beyond each row's frontier is masked off,
                 # so garbage K/V from a previous slot occupant never
@@ -528,7 +579,7 @@ class GPT(nn.Module):
         return logits
 
 
-KV_DTYPES = ("fp32", "bf16", "int8")
+KV_DTYPES = ("fp32", "bf16", "int8", "int4")
 
 
 def normalize_kv_dtype(kv_dtype) -> str | None:
@@ -537,12 +588,32 @@ def normalize_kv_dtype(kv_dtype) -> str | None:
     if kv_dtype in (None, "", "auto"):
         return None
     alias = {"fp32": "fp32", "float32": "fp32",
-             "bf16": "bf16", "bfloat16": "bf16", "int8": "int8"}
+             "bf16": "bf16", "bfloat16": "bf16", "int8": "int8",
+             "int4": "int4"}
     norm = alias.get(str(kv_dtype))
     if norm is None:
         raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
                          f"(expected one of {KV_DTYPES})")
     return norm
+
+
+def _quantized_layer_shapes(kvd: str, lead: tuple, n_head: int,
+                            length: int, head_dim: int):
+    """(value shape+dtype, scale shape) for an int8/int4 cache layer.
+    int4 packs two nibbles per byte along head_dim (uint8 storage —
+    the dtype is how every consumer tells the two modes apart); both
+    keep one f32 scale per (row, head, position) block of lanes."""
+    if kvd == "int4":
+        if head_dim % 2:
+            raise ValueError(
+                f"int4 KV packs two lanes per byte; head_dim "
+                f"{head_dim} must be even")
+        vshape = lead + (n_head, length, head_dim // 2)
+        vdtype = jnp.uint8
+    else:
+        vshape = lead + (n_head, length, head_dim)
+        vdtype = jnp.int8
+    return vshape, vdtype, lead + (n_head, length)
 
 
 def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
@@ -554,23 +625,27 @@ def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
     Stored in compute_dtype by default (bf16 on TPU): halves cache HBM and
     matches the dtype K/V are produced in, so writes are cast-free.
 
-    kv_dtype ('fp32' | 'bf16' | 'int8', see normalize_kv_dtype) overrides
-    the storage mode. 'int8' switches each layer to a 4-tuple
+    kv_dtype ('fp32' | 'bf16' | 'int8' | 'int4', see normalize_kv_dtype)
+    overrides the storage mode. 'int8' switches each layer to a 4-tuple
     (K int8, V int8, k_scale f32 (B, H, max_len), v_scale f32 likewise):
     per-(row, head, position) symmetric scales, quantize-on-write in the
     attention cache path (models above) and in scatter_cache_rows, so
     fp K/V never reaches the pool — 2x (vs bf16) / 4x (vs fp32) less HBM
     per cached token, i.e. 2x the concurrent slots at constant HBM and
-    proportionally less decode read traffic."""
+    proportionally less decode read traffic. 'int4' halves the value
+    bytes again: two nibbles per byte packed along head_dim (uint8
+    storage), the SAME per-(row, head, position) f32 residual scales,
+    round-trip error <= max|row|/7.5 per block of lanes."""
     if max_len > cfg.block_size:
         raise ValueError(
             f"cache length {max_len} > block_size {cfg.block_size}")
     kvd = normalize_kv_dtype(kv_dtype)
     head_dim = cfg.n_embd // cfg.n_head
     shape = (batch_size, cfg.n_head, max_len, head_dim)
-    if kvd == "int8":
-        sshape = (batch_size, cfg.n_head, max_len)
-        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+    if kvd in ("int8", "int4"):
+        vshape, vdtype, sshape = _quantized_layer_shapes(
+            kvd, (batch_size,), cfg.n_head, max_len, head_dim)
+        return [(jnp.zeros(vshape, vdtype), jnp.zeros(vshape, vdtype),
                  jnp.zeros(sshape, jnp.float32),
                  jnp.zeros(sshape, jnp.float32))
                 for _ in range(cfg.n_layer)]
@@ -594,23 +669,33 @@ def scatter_cache_rows(pool: list, rows: list, slots: jax.Array) -> list:
     last real slot row. Stale columns past L are hidden by the per-row
     causal mask until the new occupant's decode overwrites them.
 
-    An int8 pool (4-tuple layers) accepts fp rows — they are quantized
-    HERE, inside the compiled prefill program, so a prefill wave's K/V
-    lands already-quantized (the prefill forward itself keeps full
-    precision; only the pool representation narrows). Rows that are
-    already int8 4-tuples (an int8 temp cache) scatter as-is."""
-    from nanosandbox_tpu.ops.flash_decode import quantize_kv_rows
+    An int8/int4 pool (4-tuple layers) accepts fp rows — they are
+    quantized HERE, inside the compiled prefill program, so a prefill
+    wave's K/V lands already-quantized (the prefill forward itself
+    keeps full precision; only the pool representation narrows). Rows
+    that are already quantized 4-tuples (a quantized temp cache)
+    scatter as-is. Ladder-padding rows (slot id >= num_slots) skip the
+    quantizer's scale chain entirely — their scatter drops anyway, so
+    computing per-position amax/divide/round for them was wasted lane
+    work on every prefill wave."""
+    from nanosandbox_tpu.ops.flash_decode import (quantize_kv_rows,
+                                                  quantize_kv_rows_int4)
 
     out = []
+    num_slots = pool[0][0].shape[0]
+    # (k, 1, 1) over the wave's (k, H, L) quantize rows.
+    row_valid = (slots < num_slots)[:, None, None]
     for pool_layer, row_layer in zip(pool, rows):
         if len(pool_layer) == 4:
             pk, pv, pks, pvs = pool_layer
+            qfn = (quantize_kv_rows_int4 if pk.dtype == jnp.uint8
+                   else quantize_kv_rows)
             if len(row_layer) == 4:
                 ck, cv, cks, cvs = row_layer
             else:
                 ck, cv = row_layer
-                ck, cks = quantize_kv_rows(ck)
-                cv, cvs = quantize_kv_rows(cv)
+                ck, cks = qfn(ck, valid=row_valid)
+                cv, cvs = qfn(cv, valid=row_valid)
             L = ck.shape[2]
             pk = pk.at[slots, :, :L, :].set(ck, mode="drop")
             pv = pv.at[slots, :, :L, :].set(cv, mode="drop")
@@ -621,8 +706,8 @@ def scatter_cache_rows(pool: list, rows: list, slots: jax.Array) -> list:
         ck, cv = row_layer[0], row_layer[1]
         if len(row_layer) == 4:
             raise ValueError(
-                "cannot scatter int8 rows into a full-precision pool; "
-                "build the pool with init_cache(kv_dtype='int8')")
+                "cannot scatter quantized rows into a full-precision "
+                "pool; build the pool with init_cache(kv_dtype=...)")
         pk, pv = pool_layer
         L = ck.shape[2]
         pk = pk.at[slots, :, :L, :].set(ck.astype(pk.dtype), mode="drop")
@@ -640,14 +725,16 @@ def init_paged_cache(cfg: GPTConfig, num_blocks: int, page: int,
     positions each, and a (num_slots, max_blocks) block table (serve
     engine slot state) maps each row's logical positions onto blocks —
     allocate-on-demand memory, refcount-shared prefixes
-    (serve/paged.py). Same kv_dtype modes as init_cache; 'int8' layers
-    are 4-tuples with (num_blocks, H, page) f32 per-position scales."""
+    (serve/paged.py). Same kv_dtype modes as init_cache; 'int8'/'int4'
+    layers are 4-tuples with (num_blocks, H, page) f32 per-position
+    scales (int4 values pack two nibbles per byte along head_dim)."""
     kvd = normalize_kv_dtype(kv_dtype)
     head_dim = cfg.n_embd // cfg.n_head
     shape = (num_blocks, cfg.n_head, page, head_dim)
-    if kvd == "int8":
-        sshape = (num_blocks, cfg.n_head, page)
-        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+    if kvd in ("int8", "int4"):
+        vshape, vdtype, sshape = _quantized_layer_shapes(
+            kvd, (num_blocks,), cfg.n_head, page, head_dim)
+        return [(jnp.zeros(vshape, vdtype), jnp.zeros(vshape, vdtype),
                  jnp.zeros(sshape, jnp.float32),
                  jnp.zeros(sshape, jnp.float32))
                 for _ in range(cfg.n_layer)]

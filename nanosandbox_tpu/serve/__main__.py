@@ -34,6 +34,14 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_pipeline", action="store_true",
                     help="synchronous decode loop (debugging baseline); "
                          "default keeps one decode step in flight")
+    ap.add_argument("--scan_k", type=int, default=1,
+                    help="decode steps fused into one compiled dispatch "
+                         "(lax.scan megaprogram ladder): the host "
+                         "dispatches once per up-to-k tokens instead of "
+                         "once per token, finish detection lags the "
+                         "chunk. 1 = the classic per-token loop; "
+                         "ignored under --spec (the verify readback "
+                         "gates the next frontier)")
     ap.add_argument("--paged", default="on", choices=("on", "off"),
                     help="block-paged KV pool + radix prefix cache "
                          "(default on): admission reserves each "
@@ -52,12 +60,15 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_prefix_cache", action="store_true",
                     help="disable radix prefix reuse (paged pool only)")
     ap.add_argument("--kv_dtype", default=None,
-                    choices=("fp32", "bf16", "int8"),
+                    choices=("fp32", "bf16", "int8", "int4"),
                     help="KV-pool storage mode (default: the serving "
                          "compute dtype). int8 stores per-position "
                          "scales alongside the values: ~2x less HBM per "
                          "cached token than bf16, so 2x the slots at "
-                         "constant HBM and ~2x less decode read traffic")
+                         "constant HBM and ~2x less decode read traffic. "
+                         "int4 packs two nibbles per byte (same scale "
+                         "format): ~2x int8's slot capacity again, at "
+                         "a coarser 4-bit quantization grid")
     ap.add_argument("--decode_impl", default=None,
                     choices=("auto", "pallas", "pallas_interpret", "xla"),
                     help="cached-decode attention impl (flash-decode "
@@ -185,6 +196,7 @@ def main(argv: list[str] | None = None) -> None:
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
                     max_len=args.max_len or None,
                     pipeline=not args.no_pipeline, spec=drafter,
+                    scan_k=args.scan_k,
                     kv_dtype=args.kv_dtype, decode_impl=args.decode_impl,
                     paged=args.paged == "on",
                     kv_page_size=args.kv_page_size,
@@ -238,6 +250,13 @@ def main(argv: list[str] | None = None) -> None:
             # safe (admission happens before any donation), so flushing
             # between drains closes the hole completely.
             engine.reset_prefix_cache()
+    # The scan-chunk rung ladder (--scan_k > 1): one megaprogram per
+    # rung, compiled by dispatching each rung once over the parked slot
+    # state — the freeze below would otherwise turn the first request
+    # mix whose budgets make the chunk policy pick an uncompiled rung
+    # into a post-warmup retrace outage.
+    if args.warmup == "full":
+        engine.warm_scan_rungs()
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
           f"prefill program(s) ({args.warmup}), "
           f"{engine.trace_counts['admit']} admit, "

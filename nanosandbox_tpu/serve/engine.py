@@ -178,6 +178,25 @@ class Engine:
         synchronous loop (see module docstring); greedy outputs are
         token-identical to spec=None, sampled outputs identically
         distributed.
+    scan_k : decode steps fused into ONE compiled dispatch via lax.scan
+        (default 1, the classic per-token loop). With scan_k = k the
+        host dispatches once per k tokens — sample -> (paged) KV
+        quantize-and-write through the block table -> frontier advance
+        all stay in-program — so the per-dispatch host floor (~180 us
+        per staging upload measured in PR 9) amortizes over k tokens.
+        Finish detection lags up to k steps: a row hitting eos or its
+        budget mid-chunk keeps riding the chunk on device, its overrun
+        tokens truncate at readback, and its overrun KV writes land in
+        its own private frontier positions (dense) or drop on the
+        sentinel block-table entries past its reservation (paged) —
+        the PR 2 lagged-retire argument stretched from lag-1 to lag-k.
+        Composes with ``pipeline`` (one k-chunk in flight ahead of the
+        host); forced to 1 under ``spec`` (the verify readback gates
+        the next frontier — there is no chunk to fuse). Tradeoff:
+        larger k = fewer dispatches, but more wasted lane work when
+        rows finish mid-chunk and chunk-granular TTFT for backfilled
+        requests (docs/playbook.md has the k-vs-lag table). Greedy
+        outputs are token-identical to scan_k=1 (pinned by test).
     metrics : obs.MetricRegistry to publish on (default: a fresh
         per-engine registry — tests spin up many engines). Counters and
         gauges are mirrored from the engine's plain ints by a
@@ -262,7 +281,7 @@ class Engine:
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 pipeline: bool = True, spec=None,
+                 pipeline: bool = True, spec=None, scan_k: int = 1,
                  metrics: Optional[MetricRegistry] = None,
                  tracer: Optional[SpanTracer] = None,
                  kv_dtype: Optional[str] = None,
@@ -307,6 +326,28 @@ class Engine:
         # dispatch (and host drafters propose from the latest tokens),
         # so speculative mode runs the synchronous loop.
         self.pipeline = bool(pipeline) and spec is None
+        if scan_k < 1:
+            raise ValueError(f"scan_k must be >= 1, got {scan_k}")
+        # scan_k composes with the pipeline, not with verify: a spec
+        # step's readback gates the next frontier, so under spec the
+        # chunk length collapses to 1 (the sync loop).
+        self.scan_k = 1 if spec is not None else int(scan_k)
+        # The scan-chunk rung ladder: power-of-two chunk lengths up to
+        # scan_k (plus scan_k itself when off the ladder), one compiled
+        # megaprogram per rung. Each dispatch picks the largest rung no
+        # live row's remaining budget overruns, so a row one token from
+        # its budget pulls the chunk down to what everyone can use
+        # instead of riding 7 wasted lane-steps — budget overrun waste
+        # is structurally zero (only eos still overruns, and eos is
+        # host knowledge by design). The ladder is the compile-set
+        # growth the budgets pin: len(scan_rungs) decode programs.
+        self.scan_rungs = [1]
+        r = 2
+        while r < self.scan_k:
+            self.scan_rungs.append(r)
+            r *= 2
+        if self.scan_k > 1:
+            self.scan_rungs.append(self.scan_k)
         self.max_len = min(max_len or cfg.block_size, cfg.block_size)
         buckets = (sorted(b for b in prefill_buckets if b <= self.max_len)
                    if prefill_buckets else default_buckets(self.max_len))
@@ -369,14 +410,18 @@ class Engine:
 
         self._active: Dict[int, _Active] = {}        # slot -> state
         self._pending_results: List[Result] = []     # max_new_tokens == 0
-        # The one decode step in flight ahead of the host: (device token
-        # array, {slot: rid} snapshot at dispatch, open decode_step span
-        # id). The snapshot is the host half of the eviction lag — a
-        # slot whose occupant changed between dispatch and readback
-        # drops its ride-along token. The span closes at RETIRE, so the
-        # exported timeline shows step k overlapping step k+1's dispatch
-        # — the pipeline's true shape.
-        self._inflight: Optional[Tuple[object, Dict[int, int], int]] = None
+        # The one decode step/chunk in flight ahead of the host:
+        # (device token array — (S,) single-step or (k, S) chunk,
+        # {slot: rid} snapshot at dispatch, open decode_step span id,
+        # the dispatch's step number = the scan-chunk index the flight
+        # retire events carry, and the chunk length the next rung
+        # choice subtracts). The snapshot is the host half of the
+        # eviction lag — a slot whose occupant changed between dispatch
+        # and readback drops its ride-along tokens. The span closes at
+        # RETIRE, so the exported timeline shows chunk k overlapping
+        # chunk k+1's dispatch — the pipeline's true shape.
+        self._inflight: Optional[
+            Tuple[object, Dict[int, int], int, int, int]] = None
         self._rid = itertools.count()
         # rid -> (submit step, submit wall clock, open "queued" span id)
         self._submit_meta: Dict[int, Tuple[int, float, int]] = {}
@@ -384,6 +429,13 @@ class Engine:
         self.admitted = 0
         self.completed = 0
         self.tokens_generated = 0
+        # Host-dispatch ledger (ISSUE 12): every compiled-program launch
+        # the engine performs, by program kind — the denominator of the
+        # dispatch-floor story scan_k attacks. Plain ints on the hot
+        # path, mirrored into labeled counters at collection time.
+        self.host_dispatches: Dict[str, int] = {
+            "decode": 0, "prefill": 0, "admit": 0, "release": 0,
+            "verify": 0}
         self.shed = 0                                # deadline-expired drops
         self.rejected: Dict[str, int] = {}           # submit rejects, by kind
         # Fault-injection + crash-safe recovery state (ISSUE 11). The
@@ -454,6 +506,17 @@ class Engine:
         self._c_steps = m.counter(
             "serve_decode_steps_total",
             "Batched decode/verify step dispatches.")
+        # Dispatch-floor observability (ISSUE 12): how many compiled-
+        # program launches the host performs per kind, and how many
+        # tokens each decode dispatch amortizes (scan_k's win, live).
+        self._c_dispatches = m.counter(
+            "serve_host_dispatches_total",
+            "Compiled-program dispatches from the engine loop, by "
+            "program kind.", labelnames=("kind",))
+        self._g_toks_per_dispatch = m.gauge(
+            "serve_tokens_per_dispatch",
+            "Generated tokens per decode dispatch over the engine "
+            "lifetime (== scan_k when every chunk retires fully).")
         self._c_admitted = m.counter(
             "serve_requests_admitted_total", "Requests admitted to slots.")
         self._c_traces = m.counter(
@@ -611,9 +674,14 @@ class Engine:
         self._prefill = jax.jit(
             guard("prefill", budget["prefill"])(prefill_body),
             donate_argnums=(1,) if on_accel else ())
+        # The chunk length k is STATIC (the scan_rungs ladder): each
+        # rung traces once under the one guarded name, so the decode
+        # budget is exactly len(scan_rungs) and a rung outside the
+        # ladder raises at the retrace, not as a silent program leak.
         self._decode = jax.jit(
             guard("decode", budget["decode"])(self._decode_fn),
-            donate_argnums=(1, 2) if on_accel else ())
+            donate_argnums=(1, 2) if on_accel else (),
+            static_argnums=(3,))
         self._admit = jax.jit(
             guard("admit", budget["admit"])(self._admit_fn),
             donate_argnums=(0,) if on_accel else ())
@@ -741,16 +809,17 @@ class Engine:
                                 top_k=top_ks, top_p=top_ps)
         return pool, self._poison_guard(toks, last)
 
-    def _decode_fn(self, params, pool, state):
-        """One batched token step over ALL slots at per-row frontiers.
-
-        Returns (pool, state, tokens): pos advances and the sampled token
-        becomes the next step's input ON DEVICE, so the host can dispatch
-        step k+1 without ever reading step k back. Inactive rows are
-        parked by the mask — frozen pos, pinned token — so a released
-        slot's garbage can't random-walk its own state. Paged pools ride
-        the same program: the block table is one more state leaf, and
-        the model's cached path pages reads/writes through it."""
+    def _decode_step_fn(self, params, pool, state):
+        """One batched token step over ALL slots at per-row frontiers —
+        the scan body. pos advances and the sampled token becomes the
+        next step's input ON DEVICE, so neither the host loop (scan_k
+        == 1) nor the in-program scan (scan_k > 1) ever reads a token
+        back before continuing. Inactive rows are parked by the mask —
+        frozen pos, pinned token — so a released slot's garbage can't
+        random-walk its own state. Paged pools ride the same program:
+        the block table is one more state leaf, and the model's cached
+        path pages reads/writes through it (with sentinel entries
+        dropping any overrun row's writes)."""
         import jax.numpy as jnp
 
         from nanosandbox_tpu.sample import _sample_token, row_keys
@@ -770,6 +839,30 @@ class Engine:
                          pos=state["pos"] + active.astype(jnp.int32),
                          tok=jnp.where(active, nxt, state["tok"]))
         return pool, new_state, nxt
+
+    def _decode_fn(self, params, pool, state, k: int = 1):
+        """The decode dispatch: one token step (k == 1, tokens (S,)) or
+        the fused multi-step MEGAPROGRAM — a lax.scan of k token steps
+        inside one compiled program, tokens (k, S). The scan carries
+        (pool, state) through the same body the single-step path
+        compiles, so the modes cannot drift: row r's token at position
+        q is sampled from fold_in(key(seed_r), q) either way, and
+        greedy outputs are token-identical across every k (pinned).
+        ``k`` is a static jit arg drawn from the scan_rungs ladder —
+        one compiled program per rung, the budget max_programs()
+        publishes as {'decode': len(scan_rungs)}."""
+        if k == 1:
+            return self._decode_step_fn(params, pool, state)
+        from jax import lax
+
+        def body(carry, _):
+            pool, state = carry
+            pool, state, tok = self._decode_step_fn(params, pool, state)
+            return (pool, state), tok
+
+        (pool, state), toks = lax.scan(body, (pool, state), None,
+                                       length=k)
+        return pool, state, toks
 
     def _poison_guard(self, toks, logits):
         """In-program NaN/inf sentinel: a row whose logits went non-
@@ -836,6 +929,12 @@ class Engine:
         loop, which is how telemetry stays off the hot path."""
         self._c_tokens._set_total(self.tokens_generated)
         self._c_steps._set_total(self.steps)
+        for kind, n in list(self.host_dispatches.items()):
+            if n:
+                self._c_dispatches.labels(kind=kind)._set_total(n)
+        dec = self.host_dispatches["decode"] + self.host_dispatches["verify"]
+        self._g_toks_per_dispatch.set(
+            self.tokens_generated / dec if dec else 0.0)
         self._c_admitted._set_total(self.admitted)
         self._c_shed._set_total(self.shed)
         for reason, n in list(self.rejected.items()):
@@ -1034,7 +1133,8 @@ class Engine:
             return finished
 
         retired = False
-        if self._active and self._needs_decode():
+        chunk_len = self._next_chunk() if self._active else 0
+        if chunk_len:
             if self.faults is not None:
                 f = self.faults.fire("slow_step", self.steps)
                 if f is not None:
@@ -1042,8 +1142,9 @@ class Engine:
                                        site="slow_step", stall_s=f.stall_s)
                     time.sleep(f.stall_s)
             self._pool, self._state, toks = self._decode(
-                self.params, self._pool, self._state)
+                self.params, self._pool, self._state, chunk_len)
             self.steps += 1
+            self.host_dispatches["decode"] += 1
             if (self.faults is not None
                     and self.faults.fire("nan_logits", self.steps)
                     is not None):
@@ -1051,20 +1152,26 @@ class Engine:
                 # the retire will perform sees exactly what a real
                 # non-finite step produces (the in-program sentinel),
                 # so detection + recovery exercise the production path.
+                # Under scan_k the whole chunk poisons — the worst
+                # real case, a non-finite step mid-scan feeding every
+                # later step garbage.
                 self.flight.record("fault", step=self.steps,
                                    site="nan_logits")
-                toks = np.full(self.num_slots, self.cfg.vocab_size,
+                toks = np.full(np.shape(toks), self.cfg.vocab_size,
                                np.int32)
             snapshot = {slot: st.req.rid
                         for slot, st in self._active.items()}
             # decode_step span: opened at DISPATCH, closed at RETIRE —
             # under pipelining that close happens after the NEXT step's
             # open, so the exported timeline shows the true one-step
-            # overlap instead of a synchronous fiction.
+            # (one-CHUNK, under scan_k) overlap instead of a
+            # synchronous fiction.
             sid = self.tracer.begin("decode_step", cat="decode",
                                     args={"step": self.steps,
-                                          "rows": len(snapshot)})
-            prev, self._inflight = self._inflight, (toks, snapshot, sid)
+                                          "rows": len(snapshot),
+                                          "chunk_len": chunk_len})
+            prev, self._inflight = self._inflight, (
+                toks, snapshot, sid, self.steps, chunk_len)
             if not self.pipeline:
                 inflight, self._inflight = self._inflight, None
                 self._retire(inflight, finished)
@@ -1289,6 +1396,14 @@ class Engine:
             "prefill_buckets": list(self.sched.buckets),
             "admit_buckets": list(self.admit_buckets),
             "pipeline": self.pipeline,
+            "scan_k": self.scan_k,
+            "host_dispatches": dict(self.host_dispatches),
+            "tokens_per_dispatch": (
+                self.tokens_generated
+                / (self.host_dispatches["decode"]
+                   + self.host_dispatches["verify"])
+                if (self.host_dispatches["decode"]
+                    + self.host_dispatches["verify"]) else None),
             "active": len(self._active),
             "queued": self.sched.queued,
             "free_slots": self.sched.free_slots,
@@ -1339,10 +1454,13 @@ class Engine:
     def max_programs(self) -> dict:
         """The closed compile set by program kind — the budgets the
         tracecheck guards enforce at runtime (a retrace past these
-        raises CompileBudgetExceeded) and tests/CI assert against."""
+        raises CompileBudgetExceeded) and tests/CI assert against.
+        scan_k widens ONLY the decode entry, and exactly by its rung
+        ladder: one megaprogram per scan_rungs chunk length (scan_k=1
+        keeps the classic single program), pinned by test."""
         progs = {
             "prefill": len(self.sched.buckets) * len(self.admit_buckets),
-            "decode": 1,
+            "decode": len(self.scan_rungs),
             "admit": len(self.admit_buckets),
             "release": 1,
         }
@@ -1384,20 +1502,33 @@ class Engine:
         def sds(shape, dtype):
             return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
 
-        # int8-KV engines publish under distinct names so one budget
-        # file can pin BOTH pool modes' comms (the fleet commits both);
-        # likewise the dense (pre-paged) layout keeps a _dense suffix —
-        # the unsuffixed names ARE the paged programs now, the default
-        # engine contract the budgets pin.
-        sfx = "_kv8" if self.kv_dtype == "int8" else ""
+        # Quantized-KV engines publish under distinct names so one
+        # budget file can pin every pool mode's comms (the fleet
+        # commits int8 and int4 twins); likewise the dense (pre-paged)
+        # layout keeps a _dense suffix — the unsuffixed names ARE the
+        # paged programs, the default engine contract the budgets pin.
+        # A scan_k > 1 engine's decode is the fused megaprogram LADDER,
+        # a materially different compile surface per rung, so each rung
+        # above 1 owns a decode_scan<r> name the budget must list
+        # explicitly (rung 1 is the classic single-step program).
+        sfx = {"int8": "_kv8", "int4": "_kv4"}.get(self.kv_dtype, "")
         if not self.paged:
             sfx += "_dense"
-        specs = [ProgramSpec(
-            name=f"decode{sfx}",
-            lower=lambda: jit_rep(self._decode_fn).lower(aparams, apool,
-                                                         astate),
-            abstract_args=(aparams, apool, astate),
-            expect=expect, tags=("serve",))]
+
+        def decode_spec(r):
+            name = f"decode_scan{r}{sfx}" if r > 1 else f"decode{sfx}"
+
+            def lower(r=r):
+                return jax.jit(self._decode_fn, in_shardings=rep,
+                               out_shardings=rep,
+                               static_argnums=(3,)).lower(
+                                   aparams, apool, astate, r)
+
+            return ProgramSpec(name=name, lower=lower,
+                               abstract_args=(aparams, apool, astate),
+                               expect=expect, tags=("serve",))
+
+        specs = [decode_spec(r) for r in self.scan_rungs]
         prefill_body = (self._prefill_paged_fn if self.paged
                         else self._prefill_fn)
         for bucket in self.sched.buckets:
@@ -1624,11 +1755,13 @@ class Engine:
             self._pool, toks = self._prefill(self.params, self._pool,
                                              prompts_dev, meta_dev,
                                              fmeta_dev)
+            self.host_dispatches["prefill"] += 1
             # First tokens flow device-to-device into the slot state;
             # the host copy below is for result lists and finish checks
             # only.
             self._state = self._admit(self._state, toks, meta_dev,
                                       fmeta_dev)
+            self.host_dispatches["admit"] += 1
             if self._spec is not None and self._spec.drafter.kind == "device":
                 # The drafter ingests the SAME staged wave into its own
                 # pool (its frontier state is the engine's pos/tok, so
@@ -1786,6 +1919,7 @@ class Engine:
             runner.verify(self.params, self._pool, self._state,
                           drafts, dl)
         self.steps += 1
+        self.host_dispatches["verify"] += 1
         runner.steps += 1
         # ONE batched readback for the whole retire (synchronous by
         # design — docstring; three separate np.asarray blocks cost a
@@ -1847,31 +1981,76 @@ class Engine:
                          "drafted": int(dl.sum()),
                          "accepted": int(acc_host.sum())})
 
-    def _needs_decode(self) -> bool:
-        """False only when every active row's token budget is already
-        covered by computed tokens (read back + the one in flight) — a
-        dispatch then could only produce ride-along garbage. eos can
-        finish a row EARLIER than its budget, never later, so this
-        length-only test never skips a needed step."""
-        inflight_slots = (self._inflight[1]
-                          if self._inflight is not None else {})
-        for slot, st in self._active.items():
-            have = len(st.tokens) + (1 if inflight_slots.get(slot)
-                                     == st.req.rid else 0)
-            if have < st.req.max_new_tokens:
-                return True
-        return False
+    # A host dispatch's fixed overhead, in units of one fused scan
+    # step's device time — the rung policy's exchange rate between
+    # "fewer dispatches" and "wasted lane-steps past a row's budget".
+    # PR 9 measured ~180us per staging upload against sub-100us fused
+    # steps on the CPU floor; 2.0 is a deliberately conservative
+    # middle that also behaves on TPUs (where the fixed cost dominates
+    # tiny-step compute even harder). Exposed as an attribute so
+    # operators can re-pin it from a measured profile.
+    scan_dispatch_cost_steps = 2.0
 
-    def _retire(self, inflight: Tuple[object, Dict[int, int], int],
+    def _next_chunk(self) -> int:
+        """The next dispatch's scan-chunk length, from the scan_rungs
+        ladder — 0 when every live row's budget is already covered by
+        computed tokens (read back + the chunk in flight), meaning a
+        dispatch could only produce ride-along garbage.
+
+        The rung maximizes USEFUL lane-steps per unit wall time:
+        sum_rows min(remaining, r) / (dispatch_cost + r). When every
+        row has budget to burn this saturates at the top rung (the
+        fewest dispatches); when most rows are a token or two from
+        done it shrinks toward 1 instead of spending k lane-steps to
+        harvest one token per row. A row the chunk overruns just
+        truncates at readback (the same machinery eos overruns use —
+        eos is host knowledge by design and the one overrun no policy
+        here can see). The choice never changes the token stream:
+        chunks are dispatch boundaries, not sampling state, so greedy
+        outputs are identical across every scan_k (pinned by test).
+        eos can finish a row EARLIER than its budget, never later, so
+        the length-only remaining test never skips a needed step."""
+        inflight = self._inflight
+        inflight_slots = inflight[1] if inflight is not None else {}
+        inflight_len = inflight[4] if inflight is not None else 0
+        rems = []
+        for slot, st in self._active.items():
+            rem = st.req.max_new_tokens - len(st.tokens)
+            if inflight_slots.get(slot) == st.req.rid:
+                rem -= inflight_len
+            if rem > 0:
+                rems.append(rem)
+        if not rems:
+            return 0
+        cost = self.scan_dispatch_cost_steps
+        best, best_score = 1, -1.0
+        for r in self.scan_rungs:
+            score = sum(min(rem, r) for rem in rems) / (cost + r)
+            if score >= best_score:      # ties go to the larger rung
+                best, best_score = r, score
+        return best
+
+    def _retire(self, inflight: Tuple[object, Dict[int, int], int, int,
+                                      int],
                 finished: List[Result]) -> None:
-        """Read one dispatched step's tokens back and apply the lagged
-        finish/eviction decisions. A slot whose occupant is no longer the
-        snapshot's rid was evicted after dispatch — its ride-along token
-        belongs to nobody and is dropped (the host half of the one-step
-        finish lag; the device active mask is the other half)."""
-        toks, snapshot, sid = inflight
-        # jaxlint: disable=host-sync -- the pipelined readback: one step behind dispatch
+        """Read one dispatched step's (or scan chunk's) tokens back and
+        apply the lagged finish/eviction decisions. A slot whose
+        occupant is no longer the snapshot's rid was evicted after
+        dispatch — its ride-along tokens belong to nobody and are
+        dropped (the host half of the lag-k finish machinery; the
+        device active mask is the other half). Within a live row's
+        chunk, tokens walk in order and truncate at the first of:
+        budget reached (the row overran mid-chunk — surplus dropped),
+        eos (everything after belongs past the finish), or the poison
+        sentinel (everything after was computed FROM garbage — the
+        clean prefix is kept, the strike/recovery machinery takes the
+        rest, and the supervisor unwinds the mid-scan chunk through
+        the ordinary requeue path)."""
+        toks, snapshot, sid, chunk, _ = inflight
+        # jaxlint: disable=host-sync -- the pipelined readback: one step/chunk behind dispatch
         nxt = np.asarray(toks)
+        if nxt.ndim == 1:
+            nxt = nxt[None, :]           # (1, S): the scan_k == 1 shape
         now = time.monotonic()
         n_live = 0
         poisoned_slots: List[int] = []
@@ -1879,30 +2058,45 @@ class Engine:
             st = self._active.get(slot)
             if st is None or st.req.rid != rid:
                 continue
-            tok = int(nxt[slot])
-            if not 0 <= tok < self.cfg.vocab_size:
-                # The in-program isfinite sentinel (or an injected
-                # poison): the token is garbage and must never reach
-                # the request's output — the row keeps its clean
-                # tokens-so-far and the supervisor rebuilds from here.
-                # Without a supervisor the strikes accumulate and the
-                # row terminates 'failed' instead of wedging forever.
+            kept = 0
+            poisoned = False
+            for j in range(nxt.shape[0]):
+                if len(st.tokens) >= st.req.max_new_tokens:
+                    break                # mid-chunk budget overrun
+                tok = int(nxt[j, slot])
+                if not 0 <= tok < self.cfg.vocab_size:
+                    # The in-program isfinite sentinel (or an injected
+                    # poison): this token — and every later one in the
+                    # chunk, each sampled from state downstream of the
+                    # garbage — must never reach the request's output.
+                    poisoned = True
+                    break
+                st.tokens.append(tok)
+                kept += 1
+                if (st.req.eos_id is not None
+                        and tok == st.req.eos_id):
+                    break                # mid-chunk eos: exact truncate
+            if kept:
+                st.last_t = now
+                n_live += kept
+                # One flight event per retired (row, chunk) — n tokens
+                # at once under scan_k, with the chunk index, so
+                # per-token TPOT stays derivable from the JSONL.
+                ev = {"rid": rid, "step": self.steps, "n": kept}
+                if self.scan_k > 1:
+                    ev["chunk"] = chunk
+                self.flight.record("retire", **ev)
+            if poisoned:
                 poisoned_slots.append(slot)
                 st.poison_strikes += 1
                 if st.poison_strikes >= POISON_STRIKE_LIMIT:
                     self._fail_row(st, "persistent_poison", finished)
                 continue
-            st.tokens.append(tok)
-            st.poison_strikes = 0      # consecutive means consecutive
-            st.last_t = now
-            n_live += 1
-            # One flight event per retired token per row — the ledger's
-            # finest grain ("why did rid X stall at token 40"); recorded
-            # from the just-read-back host array, never a device value.
-            self.flight.record("retire", rid=rid, step=self.steps, n=1)
-            done = self._maybe_finish(st)
-            if done is not None:
-                finished.append(done)
+            if kept:
+                st.poison_strikes = 0    # consecutive means consecutive
+                done = self._maybe_finish(st)
+                if done is not None:
+                    finished.append(done)
         if poisoned_slots:
             self._mark_poison("poisoned_step", slots=poisoned_slots)
         self.tokens_generated += n_live
@@ -1953,6 +2147,30 @@ class Engine:
             self._spec.drafted = 0
             self._spec.accepted = 0
 
+    def warm_scan_rungs(self) -> None:
+        """Compile EVERY scan-rung megaprogram by dispatching each rung
+        once over the parked slot state — no synthetic requests, no
+        reasoning about which remaining-budget mixes the chunk policy
+        can reach (ties and mixed-row scores make that set subtle).
+        Parked rows are harmless to dispatch: their writes land at
+        their own row's position 0 (dense — overwritten by the next
+        occupant's prefill before any read, the stale-tail argument) or
+        drop on the sentinel block-table entries (paged), pos stays
+        frozen, and the garbage tokens are never read back. serve
+        __main__ --warmup=full and the bench warmups call this; a rung
+        left uncompiled would be a post-freeze retrace outage the first
+        time live traffic's budget mix makes the policy pick it.
+        Idle-only (enforced): on a busy engine the rung dispatches
+        would advance live rows' device frontiers with no readback,
+        silently dropping tokens from their outputs."""
+        if self._active or self._inflight is not None:
+            raise RuntimeError(
+                "warm_scan_rungs on a busy engine: active rows' "
+                "frontiers would advance without a readback")
+        for r in self.scan_rungs:
+            self._pool, self._state, _ = self._decode(
+                self.params, self._pool, self._state, r)
+
     def reset_prefix_cache(self) -> None:
         """Drop every cached prefix block back to the free list. Only
         legal on an idle engine (no active requests hold cache refs) —
@@ -1990,6 +2208,7 @@ class Engine:
         # pre-release state it was dispatched with.
         self._state = self._release(self._state,
                                     jnp.asarray(state.slot, jnp.int32))
+        self.host_dispatches["release"] += 1
         if state.alloc is not None:
             # Host block release: deref the hit chain, DONATE the full
             # prompt blocks to the radix cache, free the rest. Safe even
@@ -2084,6 +2303,7 @@ class Engine:
         self.sched.release(st.slot)
         self._state = self._release(self._state,
                                     jnp.asarray(st.slot, jnp.int32))
+        self.host_dispatches["release"] += 1
         if st.alloc is not None:
             # Prompt blocks are prefill-written (clean) — donation is
             # safe under the same argument recover() relies on.

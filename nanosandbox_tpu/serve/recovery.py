@@ -16,6 +16,14 @@ self-healing instead of a dead process:
                     its rows' private frontier blocks — the PR 9
                     copy-on-write argument makes the cache provably
                     clean, so every victim's resume is a prefix hit).
+                    Under the multi-token scan (scan_k > 1) the same
+                    machinery unwinds a poisoned MID-SCAN chunk: the
+                    retire keeps each row's clean pre-poison prefix
+                    and discards everything sampled downstream of the
+                    garbage (a poisoned token feeds the next scan step
+                    by construction), so the requeued prompt' = prompt
+                    + clean tokens and greedy resume stays token-
+                    identical to a no-fault run — lag-k, same proof.
   step exception    a dispatch crashed (device OOM, compile error,
                     injected prefill_exc): donated buffers may be
                     invalid, so the rebuild additionally FLUSHES the

@@ -13,6 +13,16 @@ an arbitrary request stream look like a SMALL, CLOSED set of shapes:
 Everything here is plain host-side Python (no jax import): it must be
 cheap enough to run between every decode step and testable without a
 device.
+
+Scan-chunk fencing (ISSUE 12): under the engine's multi-token decode
+scan, step() IS the chunk boundary — every wave this scheduler forms
+is popped, staged and committed between two chunk dispatches, never
+mid-chunk, and a slot freed by a chunk's retire re-enters the free
+list before the next wave forms. Admission therefore fences on chunk
+boundaries by construction; the one behavioral consequence is that
+queue-wait accounting stays in DISPATCH units (a "step" of waiting
+spans up to scan_k tokens), which the engine's queue-wait histogram
+documents.
 """
 
 from __future__ import annotations

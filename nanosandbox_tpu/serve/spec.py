@@ -38,6 +38,15 @@ The TPU discipline survives intact:
     would — even at temperature > 0 — so turning spec on is safe for
     workloads the drafter can't help.
 
+Composition with the multi-token decode scan (ISSUE 12): spec KEEPS
+the synchronous one-verify-per-dispatch loop — the verify readback
+(accepted lengths) gates the next frontier and a host drafter proposes
+from the latest tokens, so there is no k-chunk to fuse; Engine forces
+scan_k=1 under spec. What spec DOES inherit: a paged verify's T=k+1
+block now reads through the flash paged-prefill kernel when the engine
+runs a kernel impl (models/gpt.py routes every per-row T>1 paged read
+there), so the verify stops paying the gathered chain copy too.
+
 Rejection rule (greedy drafters propose point masses): accept draft d
 at position q with probability p_q(d) under the TARGET's filtered
 distribution (temperature/top-k/top-p — shared with the decode step via

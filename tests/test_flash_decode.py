@@ -117,7 +117,7 @@ def test_flash_decode_validates_scale_args():
     s = jnp.ones((1, 1, 32))
     with pytest.raises(ValueError, match="together"):
         fd.flash_decode(q, k, k, jnp.ones(1, jnp.int32), k_scale=s)
-    with pytest.raises(ValueError, match="non-int8"):
+    with pytest.raises(ValueError, match="non-quantized"):
         fd.flash_decode(q, k, k, jnp.ones(1, jnp.int32),
                         k_scale=s, v_scale=s)
     with pytest.raises(ValueError, match="unknown decode impl"):
@@ -408,3 +408,187 @@ def test_bench_decode_int8_mode_emits_comparison():
             < extra["estimated_hbm_bytes_per_token_baseline"])
     assert extra["decode_attention_impl"] == "xla"  # auto on CPU
     assert extra["decode_impl_status"]["pallas_interpret"] == "ok"
+
+
+# ------------------------------------------- paged prefill kernel + int4
+
+def _paged_reference(q, kf, vf, tbl, start):
+    """Masked reference over the gathered chains: (B, H, T, D) output
+    for (B, H, T, D) queries at positions start[b] + t."""
+    B, H, T, D = q.shape
+    N, _, page, _ = kf.shape
+    nb = tbl.shape[1]
+    kk = kf[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    vv = vf[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    qpos = start[:, None] + jnp.arange(T)[None, :]
+    mask = (jnp.arange(nb * page)[None, None, None, :]
+            <= qpos[:, None, :, None])
+    s = jnp.einsum("bhtd,bhsd->bhts", q, kk) / D ** 0.5
+    s = jnp.where(mask, s, -1e30)
+    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1), vv)
+
+
+@pytest.mark.parametrize("start", [[0, 0, 0], [0, 7, 20]])
+def test_flash_prefill_paged_matches_reference_fp(start):
+    """The T>1 paged kernel vs the gathered masked reference — cold
+    prefill (start 0) and prefix-hit offsets alike, with the split
+    masked/unmasked loop exercised (start spanning block interiors)."""
+    rng = np.random.default_rng(0)
+    B, H, T, D, N, page, nb = 3, 2, 8, 32, 16, 16, 4
+    kf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(N)[:B * nb].reshape(B, nb),
+                      jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    st = jnp.asarray(start, jnp.int32)
+    out = fd.flash_prefill_paged(q, kf, vf, tbl, st, interpret=True)
+    ref = _paged_reference(q, kf, vf, tbl, st)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kvd", ["int8", "int4"])
+def test_flash_prefill_paged_quantized_matches_reference(kvd):
+    """Quantized pools through the prefill kernel: the fused scale fold
+    equals dequantize-then-attend within float rounding."""
+    rng = np.random.default_rng(1)
+    B, H, T, D, N, page, nb = 2, 2, 8, 32, 12, 16, 3
+    kf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(N)[:B * nb].reshape(B, nb),
+                      jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    st = jnp.asarray([0, 9], jnp.int32)
+    qfn = (fd.quantize_kv_rows_int4 if kvd == "int4"
+           else fd.quantize_kv_rows)
+    kq, ks = qfn(kf)
+    vq, vs = qfn(vf)
+    out = fd.flash_prefill_paged(q, kq, vq, tbl, st, k_scale=ks,
+                                 v_scale=vs, interpret=True)
+    if kvd == "int4":
+        kd = fd.unpack_int4(kq).astype(jnp.float32) * ks[..., None]
+        vd = fd.unpack_int4(vq).astype(jnp.float32) * vs[..., None]
+    else:
+        kd = kq.astype(jnp.float32) * ks[..., None]
+        vd = vq.astype(jnp.float32) * vs[..., None]
+    ref = _paged_reference(q, kd, vd, tbl, st)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_flash_prefill_paged_sentinel_entries_harmless():
+    """Table entries at the engine's unallocated sentinel (>= N) clamp
+    in the index_map and never contribute — rows whose chains end
+    early produce the same output as a table padded with real blocks
+    the mask hides anyway."""
+    rng = np.random.default_rng(2)
+    B, H, T, D, N, page, nb = 2, 2, 4, 32, 8, 16, 4
+    kf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    st = jnp.asarray([0, 5], jnp.int32)       # frontiers inside block 0
+    tbl_real = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7]], jnp.int32)
+    tbl_sent = jnp.asarray([[0, N, N, N], [4, N, N, N]], jnp.int32)
+    out_r = fd.flash_prefill_paged(q, kf, vf, tbl_real, st,
+                                   interpret=True)
+    out_s = fd.flash_prefill_paged(q, kf, vf, tbl_sent, st,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_s),
+                               atol=1e-6)
+
+
+def test_flash_decode_paged_int4_matches_dequant_reference():
+    """T=1 paged decode through packed int4: in-kernel nibble unpack +
+    scale fold == dequantized reference."""
+    rng = np.random.default_rng(3)
+    B, H, D, N, page, nb = 3, 2, 32, 16, 16, 4
+    kf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(N)[:B * nb].reshape(B, nb),
+                      jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lens = jnp.asarray([3, 30, 63], jnp.int32)
+    kq, ks = fd.quantize_kv_rows_int4(kf)
+    vq, vs = fd.quantize_kv_rows_int4(vf)
+    out = fd.flash_decode_paged(q, kq, vq, tbl, lens, k_scale=ks,
+                                v_scale=vs, interpret=True)
+    kd = fd.unpack_int4(kq).astype(jnp.float32) * ks[..., None]
+    vd = fd.unpack_int4(vq).astype(jnp.float32) * vs[..., None]
+    kk = kd[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    vv = vd[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    ref = fd.xla_decode_attention(q, kk, vv, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_xla_decode_attention_paged_matches_gathered_reference():
+    """The gather-free XLA paged decode fast path == the gathered
+    masked reference, fp and quantized (it replaced the chain-relayout
+    copy on the CPU fallback hot path)."""
+    rng = np.random.default_rng(4)
+    B, H, D, N, page, nb = 3, 2, 32, 16, 16, 4
+    kf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(N, H, page, D)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(N)[:B * nb].reshape(B, nb),
+                      jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lens = jnp.asarray([1, 17, 64], jnp.int32)
+    out = fd.xla_decode_attention_paged(q, kf, vf, tbl, lens)
+    kk = kf[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    vv = vf[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    ref = fd.xla_decode_attention(q, kk, vv, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    kq, ks = fd.quantize_kv_rows(kf)
+    vq, vs = fd.quantize_kv_rows(vf)
+    out_q = fd.xla_decode_attention_paged(q, kq, vq, tbl, lens,
+                                          k_scale=ks, v_scale=vs)
+    kkq = kq[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    vvq = vq[tbl].transpose(0, 2, 1, 3, 4).reshape(B, H, nb * page, D)
+    kks = ks[tbl].transpose(0, 2, 1, 3).reshape(B, H, nb * page)
+    vvs = vs[tbl].transpose(0, 2, 1, 3).reshape(B, H, nb * page)
+    ref_q = fd.xla_decode_attention(q, kkq, vvq, lens, k_scale=kks,
+                                    v_scale=vvs)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(ref_q),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_engine_paged_prefill_kernel_token_exact(served_model):
+    """A paged interpret-kernel engine (prefill AND decode through the
+    Pallas paths) emits exactly the XLA engine's greedy tokens on a
+    mixed workload — the kernel swap is invisible to outputs."""
+    cfg, model, params = served_model
+    rng = np.random.default_rng(23)
+    reqs = [(rng.integers(0, 50, int(rng.integers(2, 40))).tolist(),
+             int(rng.integers(2, 8)), int(rng.integers(0, 99)))
+            for _ in range(8)]
+
+    def run(impl):
+        e = Engine(model, params, num_slots=4, max_len=64,
+                   decode_impl=impl)
+        for prompt, mnt, seed in reqs:
+            e.submit(prompt, mnt, seed=seed)
+        return {r.rid: r.tokens for r in e.drain()}
+
+    assert run("pallas_interpret") == run("xla")
+
+
+def test_init_cache_int4_layout():
+    """int4 cache layers: packed uint8 values at head_dim // 2, f32
+    per-position scales, both layouts; odd head_dim rejected."""
+    from nanosandbox_tpu.models.gpt import init_paged_cache
+
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32")
+    cache = init_cache(cfg, 3, 32, kv_dtype="int4")
+    k, v, ks, vs = cache[0]
+    assert k.shape == (3, 2, 32, 16) and k.dtype == jnp.uint8
+    assert ks.shape == (3, 2, 32) and ks.dtype == jnp.float32
+    paged = init_paged_cache(cfg, 8, 16, kv_dtype="int4")
+    pk = paged[0][0]
+    assert pk.shape == (8, 2, 16, 16) and pk.dtype == jnp.uint8
+    assert normalize_kv_dtype("int4") == "int4"
+    odd = GPTConfig(n_layer=1, n_head=3, n_embd=9, block_size=8,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32")
+    with pytest.raises(ValueError, match="even"):
+        init_cache(odd, 1, 8, kv_dtype="int4")
