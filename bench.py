@@ -23,7 +23,8 @@ Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
        python bench.py --mode=serve [--quick] [--num_slots=N] \
            [--requests=N] [--load=1,2] [--burst=6] \
            [--interactive_share=F] [--emit_obs] \
-           [--faults=chaos-smoke] [--flight_out=PATH]
+           [--faults=chaos-smoke] [--flight_out=PATH] \
+           [--sched] [--prefill_chunk=N]
 
 --mode=serve is the closed-loop load generator (Poisson arrivals at
 multiples of measured capacity, per-class deadlines, an all-at-once
@@ -39,6 +40,18 @@ goodput_under_fault_ratio (fault-point goodput / clean 1x), recovery
 counts/latency, time-to-first-retired-token — the numbers the CI chaos
 smoke pins. --flight_out dumps the fault run's flight-recorder JSONL
 for artifact upload.
+
+--sched adds the ISSUE-13 scheduling probes to the serve sweep
+(extra.scheduling): a PREFILL-STORM twin — a burst of max-length
+prompts against active decoders, chunked (--prefill_chunk, default the
+smallest bucket) vs unchunked in the same interleaved rounds, emitting
+tpot_p99_under_storm for both and their ratio (CI pins <= 0.5x); a
+PRIORITY twin at 2x capacity — class-priority scheduling + preemption
+vs a FIFO/no-preemption engine on identical arrivals, emitting
+per-class attainment (CI pins interactive strictly above the FIFO
+twin); and a PREEMPT-RESUME PARITY probe — a preempt_storm fault plan
+repeatedly evicting victims, outputs compared token-for-token against
+a clean twin (CI pins parity == 1.0).
 
 --emit_obs attaches the obs metric-registry snapshot (the same series a
 live /metrics scrape exposes) to the JSON under "obs".
@@ -748,6 +761,221 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     }
 
 
+def _serve_warmup(engine, max_len: int) -> None:
+    """Compile a serve engine's reachable admission set by driving the
+    real submit/drain path (one wave per (rung, bucket) pair; chunked
+    engines compile their chunk shapes the same way), then clear the
+    measurement windows — shared by bench_serve's main engine and the
+    priority-overload twins (the storm twins instead warm with an
+    untimed round of their own storm shape, and the parity probe is
+    untimed)."""
+    lo = 1
+    for bucket in engine.sched.buckets:
+        length = min(bucket, max_len - 2)
+        lo, prev_lo = bucket + 1, lo
+        if length < prev_lo:
+            continue
+        for k in engine.admit_buckets:
+            for _ in range(k):
+                engine.submit([0] * length, 2)
+            engine.drain()
+            engine.reset_prefix_cache()
+    engine.reset_latency_stats()
+
+
+def _bench_serve_scheduling(build_engine, *, cfg, num_slots, max_len,
+                            chunk, quick, req_rate_1x, deadline_i,
+                            deadline_b, max_prompt, max_new) -> dict:
+    """The ISSUE-13 scheduling probes (--sched): prefill-storm twin,
+    priority-vs-FIFO twin at overload, and preemption-resume parity.
+    Each probe builds fresh engine twins off ``build_engine`` and runs
+    them in the interleaved/identical-input style the decode bench
+    twins use, so host noise cannot manufacture a ratio."""
+    import time
+
+    import numpy as np
+
+    from nanosandbox_tpu.obs import TERMINAL_EVENTS
+    from nanosandbox_tpu.serve import EngineSupervisor, FaultPlan
+
+    rng = np.random.default_rng(777)
+
+    # ---- 1. prefill storm: chunked vs unchunked twin -----------------
+    # A burst of max-length prompts lands while half the slots decode.
+    # The decoders' inter-token gaps come from their flight-recorder
+    # retire timestamps; the p99 of those gaps IS TPOT-under-storm.
+    rounds = 3 if quick else 5
+    engines = {"chunked": build_engine(prefill_chunk=chunk),
+               "unchunked": build_engine()}
+    n_dec = max(2, num_slots // 2)
+    dec_budget = max(8, max_len - 12)
+    storm_len = max_len - 2
+    n_storm = num_slots
+    missing = 0
+
+    def storm_round(eng, seed):
+        r = np.random.default_rng(seed)
+        eng.reset_latency_stats()
+        if eng.paged:
+            eng.reset_prefix_cache()
+        dec = [eng.submit(r.integers(0, cfg.vocab_size, 4).tolist(),
+                          dec_budget, slo_class="interactive")
+               for _ in range(n_dec)]
+        for _ in range(6):
+            eng.step()
+        storm = [eng.submit(
+            r.integers(0, cfg.vocab_size, storm_len).tolist(), 2,
+            slo_class="batch") for _ in range(n_storm)]
+        eng.drain()
+        events = eng.flight.events()
+        gaps = []
+        for rid in dec:
+            ts = [e["t"] for e in events
+                  if e.get("rid") == rid and e["ev"] == "retire"]
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        miss = sum(1 for rid in dec + storm
+                   if len([e for e in events if e.get("rid") == rid
+                           and e["ev"] in TERMINAL_EVENTS]) != 1)
+        return (float(np.percentile(gaps, 99)) if gaps else 0.0), miss
+
+    for eng in engines.values():
+        storm_round(eng, seed=123)       # untimed compile round
+    p99s = {name: [] for name in engines}
+    for i in range(rounds):
+        order = list(engines)
+        if i % 2:
+            order.reverse()              # rotation: no fixed adjacency
+        for name in order:
+            p99, miss = storm_round(engines[name], seed=1000 + i)
+            p99s[name].append(p99)
+            missing += miss
+    med = {n: float(np.median(v)) for n, v in p99s.items()}
+    storm = {"tpot_p99_under_storm": med["chunked"],
+             "tpot_p99_under_storm_unchunked": med["unchunked"],
+             "tpot_p99_ratio": (med["chunked"] / med["unchunked"]
+                                if med["unchunked"] else None),
+             "rounds": rounds, "per_round_p99_s": p99s,
+             "prefill_chunk": chunk, "storm_size": n_storm,
+             "active_decoders": n_dec,
+             "unreached_terminals": missing}
+
+    # ---- 2. priority + preemption vs FIFO at 2x capacity -------------
+    # Identical arrival schedule and request stream against two twins:
+    # class priorities + preemption on, vs every submission at one
+    # priority with preemption off (the pre-ISSUE-13 FIFO engine).
+    # Interactive is the MINORITY class (~35% of requests, small
+    # budgets): its own offered load fits inside capacity, so priority
+    # scheduling can actually save it — the overload is the long batch
+    # work FIFO head-of-line-blocks it behind. (A majority class past
+    # capacity on its own is unsavable by ANY ordering.)
+    # Long enough that 2x-capacity arrivals build a REAL backlog: work
+    # arrives at ~2x the service rate, so unfinished work at the last
+    # arrival grows to ~half the total — n_req = 24 * num_slots makes
+    # that terminal backlog ~12 batch-turnovers (base_lat units), 4x
+    # the interactive deadline below, so the FIFO twin's misses are a
+    # structural fraction of the class, not a tail-of-window accident.
+    # (With every shape precompiled by _serve_warmup there are no
+    # compile stalls left to manufacture queueing, so the run length
+    # must produce it honestly; the timed window stays sub-second on
+    # the quick CPU config — requests are a few tokens each.)
+    n_req = 24 * num_slots
+    arrivals = np.cumsum(
+        rng.exponential(1.0 / (req_rate_1x * 2.0), n_req)).tolist()
+    reqs = []
+    for _ in range(n_req):
+        L = int(rng.integers(1, max_prompt))
+        prompt = rng.integers(0, cfg.vocab_size, L).tolist()
+        if rng.random() < 0.35:
+            # The sweep's own interactive deadline (3x base_lat):
+            # meetable WHEN the class is prioritized (its own load fits
+            # inside capacity, so it only ever waits behind in-service
+            # batch rows — and a deadline-pressed head preempts those),
+            # hopeless for the later arrivals when FIFO parks them
+            # behind a batch backlog that passes 3 base_lat mid-run —
+            # which is exactly the separation the CI pin asserts.
+            mnt = int(rng.integers(max(1, max_new // 4),
+                                   max(2, max_new // 2)))
+            reqs.append((prompt, mnt, "interactive", deadline_i))
+        else:
+            mnt = int(rng.integers(max(2, max_new // 2), max_new + 1))
+            reqs.append((prompt, mnt, "batch", deadline_b))
+
+    def overload_point(eng, submit_priority=None):
+        # Untimed FULL-GRID warmup — every (rung, bucket) admission
+        # shape, not just the shapes the first few requests happen to
+        # hit: a mid-window arrival landing on an uncompiled shape
+        # would stall queued deadlines on an XLA compile and charge
+        # the attainment pin to compile placement instead of
+        # scheduling policy.
+        _serve_warmup(eng, max_len)
+        if eng.paged:
+            eng.reset_prefix_cache()
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(arrivals) or eng.has_work():
+            now = time.perf_counter() - t0
+            while i < len(arrivals) and arrivals[i] <= now:
+                p, mnt, cls, dl = reqs[i]
+                kw = {"deadline_s": dl, "slo_class": cls}
+                if submit_priority is not None:
+                    kw["priority"] = submit_priority
+                eng.submit(p, mnt, **kw)
+                i += 1
+            if eng.has_work():
+                eng.step()
+            else:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.002))
+        classes = eng.stats()["slo"]["classes"]
+        return {c: {"attainment": s["attainment"],
+                    "goodput_tokens": s["goodput_tokens"],
+                    "met": s["met"], "missed": s["missed"],
+                    "shed": s["shed"]} for c, s in classes.items()}
+
+    pri_on = overload_point(build_engine(preemption=True))
+    pri_off = overload_point(build_engine(preemption=False),
+                             submit_priority=1)
+    priority = {
+        "arrival_multiplier": 2.0, "requests": n_req,
+        "per_class": pri_on, "per_class_priority_off": pri_off,
+        "interactive_attainment":
+            pri_on.get("interactive", {}).get("attainment"),
+        "interactive_attainment_priority_off":
+            pri_off.get("interactive", {}).get("attainment"),
+    }
+
+    # ---- 3. preemption-resume greedy parity --------------------------
+    # A preempt_storm plan evicts victims repeatedly; every output must
+    # be token-identical to the clean twin's (the resume = prefix-hit
+    # re-prefill continues the same fold_in(seed, position) stream).
+    par_reqs = []
+    for i in range(2 * num_slots):
+        L = int(rng.integers(1, max_prompt))
+        par_reqs.append((rng.integers(0, cfg.vocab_size, L).tolist(),
+                         int(rng.integers(4, max_new + 1)),
+                         "batch" if i % 2 else "interactive"))
+    clean = build_engine()
+    [clean.submit(p, m, slo_class=c) for p, m, c in par_reqs]
+    want = [r.tokens for r in sorted(clean.drain(), key=lambda r: r.rid)]
+    plan = FaultPlan.parse("preempt_storm@2x4")
+    chaotic = build_engine(faults=plan)
+    sup = EngineSupervisor(chaotic, backoff_base_s=0.0)
+    [chaotic.submit(p, m, slo_class=c) for p, m, c in par_reqs]
+    got_map = {}
+    guard = 0
+    while chaotic.has_work() and guard < 200_000:
+        for r in sup.step():
+            got_map[r.rid] = r
+        guard += 1
+    got = [got_map[rid].tokens for rid in sorted(got_map)]
+    matches = sum(1 for a, b in zip(want, got) if a == b)
+    parity = (matches / len(want)) if want else None
+
+    return {"storm": storm, "priority": priority,
+            "preempt_resume_parity": parity,
+            "parity_probe_preemptions": chaotic.preemptions,
+            "parity_probe_requests": len(par_reqs)}
+
+
 def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     """Closed-loop serving load generator: goodput under overload.
 
@@ -826,9 +1054,18 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         from nanosandbox_tpu.serve import EngineSupervisor, FaultPlan
         fault_plan = FaultPlan.parse(faults_spec)
         fault_plan.enabled = False
-    engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
-                    pipeline=True, paged=paged, kv_page_size=kv_page,
-                    faults=fault_plan)
+    prefill_chunk = int(kv.get("prefill_chunk", 0)) or None
+
+    def build_engine(**kw):
+        """One more engine with the sweep's layout — the scheduling
+        probes build twins (chunked/unchunked, priority/FIFO, clean/
+        chaotic) off the same baseline."""
+        kw.setdefault("paged", paged)
+        kw.setdefault("kv_page_size", kv_page)
+        return Engine(model, params, num_slots=num_slots,
+                      max_len=max_len, pipeline=True, **kw)
+
+    engine = build_engine(faults=fault_plan, prefill_chunk=prefill_chunk)
     if fault_plan is not None:
         stepper = EngineSupervisor(engine, backoff_base_s=0.01,
                                    backoff_max_s=0.5)
@@ -850,20 +1087,12 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             cls, dl = "batch", deadline_b
         return prompt, mnt, cls, dl
 
-    # Warmup: compile every (rung, bucket) program (the decode-bench
-    # discipline — a timed point must never eat an XLA compile).
-    lo = 1
-    for bucket in engine.sched.buckets:
-        length = min(bucket, max_len - 2)
-        lo, prev_lo = bucket + 1, lo
-        if length < prev_lo:
-            continue
-        for k in engine.admit_buckets:
-            for _ in range(k):
-                engine.submit([0] * length, 2)
-            engine.drain()
-            engine.reset_prefix_cache()
-    engine.reset_latency_stats()
+    # Warmup: compile every reachable (rung, bucket) program (the
+    # decode-bench discipline — a timed point must never eat an XLA
+    # compile). Under --prefill_chunk the reachable set is smaller (big
+    # buckets route through the chunk lane) and the warmup, going
+    # through the same admission code, compiles exactly that set.
+    _serve_warmup(engine, max_len)
 
     # Capacity probe: saturated drain, no deadlines.
     n_cap = 3 * num_slots
@@ -986,6 +1215,19 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
                 under_fault / clean_1x if clean_1x else None),
         }
 
+    sched_extra = None
+    if _flag(kv, "sched"):
+        # Scheduling probes (ISSUE 13): storm twin, priority twin,
+        # preemption-resume parity. Default chunk = the smallest
+        # bucket (the finest interleave the compiled grid offers).
+        chunk = prefill_chunk or min(engine.sched.buckets)
+        sched_extra = _bench_serve_scheduling(
+            build_engine, cfg=cfg, num_slots=num_slots,
+            max_len=max_len, chunk=chunk, quick=quick,
+            req_rate_1x=req_rate_1x, deadline_i=deadline_i,
+            deadline_b=deadline_b, max_prompt=max_prompt,
+            max_new=max_new)
+
     one_x = sweep.get("1x") or next(iter(sweep.values()))
     from nanosandbox_tpu.analysis.shardcheck import provenance
 
@@ -1016,8 +1258,10 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "deadline_batch_s": deadline_b,
             "interactive_share": interactive_share,
             "req_per_s_1x": req_rate_1x,
+            "prefill_chunk": prefill_chunk,
             "sweep": sweep,
             "fault": fault_extra,
+            "scheduling": sched_extra,
             "watchdog_trips": engine.stats()["watchdog"]["trips"],
             "trace_counts": dict(engine.trace_counts),
         },
@@ -1034,6 +1278,8 @@ def main(argv: list[str]) -> dict:
         kv.setdefault("repetitive", "1")
     if "--emit_obs" in argv:
         kv.setdefault("emit_obs", "1")
+    if "--sched" in argv:
+        kv.setdefault("sched", "1")
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -135,6 +135,17 @@ class SLOLedger:
             self._g_attain.labels(slo_class=name).set(
                 led.met / total if total else 0.0)
 
+    def totals(self) -> tuple:
+        """(met, missed, shed) across every class — the brownout
+        controller differences this between check windows, so it must
+        stay a few int adds (no dict building per step)."""
+        met = missed = shed = 0
+        for led in self._classes.values():
+            met += led.met
+            missed += led.missed
+            shed += led.shed
+        return met, missed, shed
+
     def stats(self) -> dict:
         """The Engine.stats()["slo"] view: per-class dicts plus the
         cross-class rollup bench.py's overload sweep reads."""

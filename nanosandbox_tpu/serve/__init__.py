@@ -33,14 +33,21 @@ Pieces:
   recovery.py  — EngineSupervisor: crash-safe stepping — quarantine,
                  device-state rebuild, re-admission of in-flight
                  requests, bounded backoff, permanent-failure drain.
+  brownout.py  — BrownoutController: SLO-ledger-driven graceful load
+                 degradation (shrink scan -> suspend spec -> shed
+                 batch -> interactive only) with hysteresis.
   __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
                  checkpoint and serve it.
 """
 
+from nanosandbox_tpu.serve.brownout import LEVELS as BROWNOUT_LEVELS
+from nanosandbox_tpu.serve.brownout import BrownoutController
 from nanosandbox_tpu.serve.drafters import (ModelDrafter, NGramDrafter,
                                             drafter_from_flag)
-from nanosandbox_tpu.serve.engine import (Engine, EngineFailedError,
-                                          Request, Result)
+from nanosandbox_tpu.serve.engine import (DEFAULT_PRIORITY,
+                                          PRIORITY_BY_CLASS, Engine,
+                                          EngineFailedError, Request,
+                                          Result)
 from nanosandbox_tpu.serve.faults import (CANNED, FaultInjected, FaultPlan,
                                           FaultSpec)
 from nanosandbox_tpu.serve.paged import (Allocation, BlockPool,
@@ -54,4 +61,6 @@ __all__ = ["Engine", "Request", "Result", "SlotScheduler",
            "ModelDrafter", "drafter_from_flag", "BlockPool",
            "RadixPrefixCache", "Allocation", "blocks_for",
            "FaultPlan", "FaultSpec", "FaultInjected", "CANNED",
-           "EngineSupervisor", "EngineFailedError"]
+           "EngineSupervisor", "EngineFailedError",
+           "BrownoutController", "BROWNOUT_LEVELS",
+           "PRIORITY_BY_CLASS", "DEFAULT_PRIORITY"]

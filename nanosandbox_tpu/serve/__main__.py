@@ -34,6 +34,30 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--no_pipeline", action="store_true",
                     help="synchronous decode loop (debugging baseline); "
                          "default keeps one decode step in flight")
+    ap.add_argument("--prefill_chunk", type=int, default=0,
+                    help="per-step prefill token budget (must be one of "
+                         "the prefill buckets; 0 = off): admission "
+                         "waves are paced and long prompts split into "
+                         "chunk-sized prefills interleaved with decode "
+                         "steps, so a prefill storm cannot spike active "
+                         "requests' TPOT. Paged engines only for the "
+                         "splitting half; the compile set does not grow")
+    ap.add_argument("--no_preemption", action="store_true",
+                    help="disable deadline-driven preemption-by-"
+                         "eviction (default on: when the highest-"
+                         "priority queued request would miss its "
+                         "deadline waiting on slots/KV blocks, the "
+                         "lowest-priority active request is evicted — "
+                         "its blocks donate to the prefix cache and it "
+                         "resumes token-identically as a prefix hit)")
+    ap.add_argument("--brownout", default="on", choices=("on", "off"),
+                    help="SLO-driven brownout degradation ladder "
+                         "(default on): under sustained deadline burn "
+                         "the engine steps through shrink-scan -> "
+                         "suspend-spec -> shed-batch -> interactive-"
+                         "only, with hysteresis; each transition is a "
+                         "flight/metrics event. Costs nothing without "
+                         "deadlines")
     ap.add_argument("--scan_k", type=int, default=1,
                     help="decode steps fused into one compiled dispatch "
                          "(lax.scan megaprogram ladder): the host "
@@ -205,7 +229,10 @@ def main(argv: list[str] | None = None) -> None:
                     watchdogs=not args.no_watchdogs,
                     watchdog_dir=args.watchdog_dir,
                     default_deadline_s=args.deadline_s or None,
-                    faults=fault_plan)
+                    faults=fault_plan,
+                    prefill_chunk=args.prefill_chunk or None,
+                    preemption=not args.no_preemption,
+                    brownout=args.brownout == "on")
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -315,7 +342,11 @@ def main(argv: list[str] | None = None) -> None:
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
           f"{engine.max_len} ctx ({pool_desc}, kv_dtype={engine.kv_dtype}, "
           f"decode_impl={engine.decode_impl}, recovery="
-          f"{'off' if supervisor is None else 'on'}); prefill buckets "
+          f"{'off' if supervisor is None else 'on'}, "
+          f"prefill_chunk={engine.prefill_chunk or 'off'}, preemption="
+          f"{'on' if engine.preemption else 'off'}, brownout="
+          f"{'on' if engine.brownout is not None else 'off'}); "
+          f"prefill buckets "
           f"{engine.sched.buckets}; listening on "
           f"{args.host}:{args.port} (POST /generate /drain /profile, "
           "GET /healthz[?ready=1] /stats /metrics /trace "

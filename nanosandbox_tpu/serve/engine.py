@@ -79,10 +79,19 @@ import numpy as np
 from nanosandbox_tpu.obs import (FlightRecorder, MetricRegistry, SLOLedger,
                                  SpanTracer, WatchdogPanel,
                                  validate_slo_class)
+from nanosandbox_tpu.serve.brownout import BrownoutController
 from nanosandbox_tpu.serve.faults import FaultInjected, FaultPlan
 from nanosandbox_tpu.serve.scheduler import SlotScheduler, default_buckets
 from nanosandbox_tpu.utils import tracecheck as _tracecheck
 from nanosandbox_tpu.utils.tracecheck import TraceBudgetRegistry
+
+# Scheduling priority by SLO class (ISSUE 13): higher admits first.
+# Interactive traffic outranks the default class, which outranks batch;
+# an explicit Request.priority overrides the class mapping, and unknown
+# classes land on the default. The brownout ladder's shed floors
+# (serve/brownout.py) are expressed against these same numbers.
+PRIORITY_BY_CLASS = {"batch": 0, "default": 1, "interactive": 2}
+DEFAULT_PRIORITY = 1
 
 
 # Consecutive poisoned readbacks a row survives before it terminates
@@ -106,7 +115,9 @@ class Request:
     """One generation request, in token-id space (the HTTP layer owns
     text <-> tokens). ``deadline_s`` is the submit-to-finish SLO budget
     (None = best-effort: never SLO-tracked, never shed); ``slo_class``
-    labels the request's SLO accounting on /metrics."""
+    labels the request's SLO accounting on /metrics; ``priority``
+    orders the scheduler queue (higher first; defaulted from the class
+    via PRIORITY_BY_CLASS) and decides who preempts whom."""
     rid: int
     prompt: tuple
     max_new_tokens: int
@@ -117,6 +128,7 @@ class Request:
     eos_id: Optional[int] = None
     deadline_s: Optional[float] = None
     slo_class: str = "default"
+    priority: int = DEFAULT_PRIORITY
 
 
 @dataclass
@@ -146,12 +158,30 @@ class _Active:
 @dataclass
 class _Resume:
     """Host-side stitch record for a request re-admitted after an
-    engine recovery: the ORIGINAL prompt and the tokens generated
-    before the fault, so the terminal Result (and its flight/SLO
-    accounting) reads as one uninterrupted request."""
+    engine recovery OR a priority preemption: the ORIGINAL prompt and
+    the tokens generated before the interruption, so the terminal
+    Result (and its flight/SLO accounting) reads as one uninterrupted
+    request."""
     prompt: tuple
     tokens: List[int]
     submit_t: float
+
+
+@dataclass
+class _Chunking:
+    """One request mid-chunked-prefill (ISSUE 13): popped from the
+    queue with a slot claimed and ALL blocks reserved, its (suffix)
+    prompt lands in the KV pool across several bucket-shaped prefill
+    dispatches interleaved with decode steps. ``hit`` is the prefix-
+    cache hit (the first chunk's cache_index); ``done`` counts suffix
+    tokens already written. Intermediate chunks carry the sentinel slot
+    id — no admit scatter, no readback — so only the FINAL chunk
+    samples a first token and activates the row."""
+    req: Request
+    slot: int
+    alloc: object
+    hit: int
+    done: int = 0
 
 
 class Engine:
@@ -276,6 +306,33 @@ class Engine:
         degrades that step to plain decode) before speculative decoding
         auto-DISABLES for the engine's lifetime — degrade, don't die:
         a dead drafter costs throughput, never correctness or uptime.
+    prefill_chunk : per-STEP prefill token budget (ISSUE 13; None = the
+        classic admit-everything-now behavior). Must be one of the
+        prefill buckets. Each engine step spends at most ~this many
+        prefill tokens before dispatching its decode step, so a
+        prefill storm interleaves with decode instead of stalling every
+        active row's TPOT for the whole wave. Paged engines
+        additionally SPLIT a single long (suffix) prompt into
+        chunk-sized pieces across steps — each chunk is an ordinary
+        (1, bucket) prefill dispatch writing at cache_index = tokens-
+        already-prefilled, exactly the prefix-hit machinery, so the
+        compile set does not widen (max_programs() identical, pinned).
+        Dense engines cannot split one prompt (their prefill has no
+        write offset) and fall back to pacing whole waves.
+    preemption : allow deadline-driven preemption-by-eviction (default
+        True): when the highest-priority queued request would miss its
+        deadline waiting on slots or KV blocks, the lowest-priority
+        active victim is evicted — its blocks (prompt AND generated)
+        are donated to the radix cache and it requeues with prompt' =
+        prompt + tokens-so-far through the recovery _Resume path, so
+        its resume is a prefix hit and greedy output is token-identical
+        to an unpreempted run (pinned). Equal-priority traffic never
+        preempts, so single-class deployments behave exactly as before.
+    brownout : attach a BrownoutController (serve/brownout.py): an
+        SLO-ledger-driven ladder of named degradation levels (shrink
+        scan chunk -> suspend spec -> shed batch class -> interactive
+        only) with hysteresis, each transition a flight/metrics event.
+        Default False; `python -m nanosandbox_tpu.serve` turns it on.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -294,7 +351,10 @@ class Engine:
                  watchdog_dir: Optional[str] = None,
                  default_deadline_s: Optional[float] = None,
                  faults: Optional[FaultPlan] = None,
-                 spec_fault_tolerance: int = 3):
+                 spec_fault_tolerance: int = 3,
+                 prefill_chunk: Optional[int] = None,
+                 preemption: bool = True,
+                 brownout: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -356,6 +416,27 @@ class Engine:
                              f"{self.max_len}: {prefill_buckets!r}")
         self.sched = SlotScheduler(num_slots, buckets)
         self.admit_buckets = self.sched.admit_buckets
+        # Chunked prefill (ISSUE 13): the per-step prefill token budget.
+        # The chunk must be a BUCKET so chunk dispatches reuse the
+        # existing (rung, bucket) prefill grid — any other size would
+        # either widen the compile set or pad every chunk.
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk not in self.sched.buckets:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must be one of the "
+                    f"prefill buckets {self.sched.buckets} (chunk "
+                    "dispatches reuse the bucket-shaped programs)")
+        self.prefill_chunk = prefill_chunk
+        self.preemption = bool(preemption)
+        self.preemptions = 0
+        self._prefill_spent = 0              # tokens this step (budgeted)
+        self._chunking: List[_Chunking] = []  # mid-chunked-prefill lane
+        # Brownout knobs (set by the controller; consulted on the hot
+        # path as one attribute read each — see serve/brownout.py).
+        self.scan_cap: Optional[int] = None
+        self.spec_suspended = False
+        self.brownout_min_priority: Optional[int] = None
 
         if self.decode_impl != "xla":
             from nanosandbox_tpu.ops.flash_decode import (decode_pad_copies,
@@ -437,6 +518,12 @@ class Engine:
             "decode": 0, "prefill": 0, "admit": 0, "release": 0,
             "verify": 0}
         self.shed = 0                                # deadline-expired drops
+        # Deadline-carrying sheds CAUSED BY the brownout floor (subset
+        # of the SLO ledger's shed count): the controller subtracts
+        # these from its window signal — its own shedding must read as
+        # load REMOVED, not as ongoing burn, or level 3 would sustain
+        # itself on the traffic it sheds and never clear.
+        self.brownout_sheds = 0
         self.rejected: Dict[str, int] = {}           # submit rejects, by kind
         # Fault-injection + crash-safe recovery state (ISSUE 11). The
         # hooks cost one `is None` branch each when no plan is attached;
@@ -579,6 +666,12 @@ class Engine:
         self._c_shed = m.counter(
             "serve_requests_shed_total",
             "Queued requests shed after their deadline expired.")
+        # Scheduling-endgame signal (ISSUE 13): preemption-by-eviction
+        # events, mirrored from a plain int at collection time.
+        self._c_preempted = m.counter(
+            "serve_preemptions_total",
+            "Active requests preempted (evicted + requeued) so a "
+            "higher-priority deadline could admit.")
         # Crash-safe recovery signal (ISSUE 11): recovery cycles by
         # cause, rebuild latency, poisoned steps caught by the in-
         # program isfinite guard, re-admissions, drafter faults, and a
@@ -688,6 +781,11 @@ class Engine:
         self._release = jax.jit(
             guard("release", budget["release"])(self._release_fn),
             donate_argnums=(0,) if on_accel else ())
+
+        # The brownout ladder (ISSUE 13): constructed last so the
+        # controller sees the finished engine (slo ledger, metrics,
+        # scan rungs all in place).
+        self.brownout = BrownoutController(self) if brownout else None
 
     # ------------------------------------------------------------------
     # compiled step functions
@@ -937,6 +1035,7 @@ class Engine:
             self.tokens_generated / dec if dec else 0.0)
         self._c_admitted._set_total(self.admitted)
         self._c_shed._set_total(self.shed)
+        self._c_preempted._set_total(self.preemptions)
         for reason, n in list(self.rejected.items()):
             self._c_rejected.labels(reason=reason)._set_total(n)
         self._c_poisoned._set_total(self.poisoned_steps)
@@ -973,13 +1072,19 @@ class Engine:
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               slo_class: str = "default") -> int:
+               slo_class: str = "default",
+               priority: Optional[int] = None) -> int:
         """Queue one request; returns its id. Fixed-shape admission rules
         are enforced here so a bad request fails at submit, not as a
         mid-flight surprise — every reject leaves a terminal ``reject``
         event in the flight ledger. ``deadline_s`` (default: the
         engine's default_deadline_s) arms SLO accounting and queue-time
-        shedding; ``slo_class`` labels it on /metrics."""
+        shedding; ``slo_class`` labels it on /metrics; ``priority``
+        (default: PRIORITY_BY_CLASS[slo_class]) orders the queue and
+        the preemption policy. Under an active brownout shed floor a
+        below-floor submission is accepted but immediately SHED (a
+        terminal 'shed' Result — 429 + Retry-After upstream, never a
+        silent queue-rot)."""
         prompt = tuple(int(t) for t in prompt)
         plen = len(prompt)
         if self.failed:
@@ -1039,18 +1144,40 @@ class Engine:
                     f"request needs {need} KV blocks but the pool holds "
                     f"{self.kv_pool_blocks}; raise kv_pool_blocks or "
                     "shorten the request", prompt_len=plen)
+        if priority is None:
+            priority = PRIORITY_BY_CLASS.get(slo_class, DEFAULT_PRIORITY)
+        else:
+            priority = int(priority)
         rid = next(self._rid)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), seed=int(seed), eos_id=eos_id,
-                      deadline_s=deadline_s, slo_class=slo_class)
+                      deadline_s=deadline_s, slo_class=slo_class,
+                      priority=priority)
         self._c_submitted.inc()
         sub_fields = {"prompt_len": plen, "max_new": max_new_tokens,
-                      "slo_class": slo_class}
+                      "slo_class": slo_class, "priority": priority}
         if deadline_s is not None:
             sub_fields["deadline_s"] = deadline_s
         self.flight.record("submit", rid=rid, step=self.steps,
                            **sub_fields)
+        floor = self.brownout_min_priority
+        if floor is not None and priority < floor:
+            # Brownout shed-at-submit: the request is valid but the
+            # engine is deliberately refusing its class right now —
+            # terminal 'shed' (429 + Retry-After upstream), counted
+            # against its class's attainment, zero resources spent.
+            self.shed += 1
+            self.flight.record("shed", rid=rid, step=self.steps,
+                               reason="brownout", slo_class=slo_class,
+                               priority=priority, floor=floor)
+            if deadline_s is not None:
+                self.slo.record_shed(slo_class)
+                self.brownout_sheds += 1
+            self._pending_results.append(
+                Result(rid=rid, prompt=prompt, tokens=[],
+                       finish_reason="shed"))
+            return rid
         if max_new_tokens == 0:
             # Counts as completed too (never reaches _finish): the
             # natural submitted-minus-completed in-flight alert must
@@ -1074,7 +1201,7 @@ class Engine:
         return rid
 
     def has_work(self) -> bool:
-        return bool(self._active or self.sched.queued
+        return bool(self._active or self.sched.queued or self._chunking
                     or self._pending_results or self._inflight is not None)
 
     def step(self) -> List[Result]:
@@ -1089,6 +1216,7 @@ class Engine:
             finished, self._pending_results = self._pending_results, []
             return finished
         t0 = time.monotonic()
+        self._prefill_spent = 0        # the per-step chunked-prefill budget
         traces0 = sum(self.tracecheck.counts().values())
         self._profile_window_start()
         finished = self._step_impl()
@@ -1103,6 +1231,8 @@ class Engine:
         if sum(self.tracecheck.counts().values()) == traces0:
             self.watchdog.on_step_time(time.monotonic() - t0)
         self.watchdog.check()
+        if self.brownout is not None:
+            self.brownout.on_step()
         return finished
 
     def _step_impl(self) -> List[Result]:
@@ -1116,11 +1246,16 @@ class Engine:
         # admission, so an expired request never eats a slot, a prefill
         # program, or KV blocks on its way to a missed SLO.
         self._shed_expired(finished)
+        # Preemption-by-eviction (ISSUE 13): if the highest-priority
+        # queued deadline would expire waiting on slots/blocks, evict
+        # the lowest-priority victim now so the admission below can
+        # take its place. (Also hosts the preempt_storm fault site.)
+        self._maybe_preempt()
         # Backfill free slots mid-flight; a wave finishing on its prefill
         # tokens immediately frees slots for the next wave in line.
         self._admit_waves(finished)
 
-        if self._spec is not None:
+        if self._spec is not None and not self.spec_suspended:
             # Speculative step: draft -> one fixed-shape verify ->
             # retire, synchronously (any live row needs >= 1 more token
             # by construction — rows finish the moment they hit budget).
@@ -1195,19 +1330,23 @@ class Engine:
         return finished
 
     def _shed_expired(self, finished: List[Result]) -> None:
-        """Drop queued requests whose deadline expired while waiting:
+        """Drop queued requests whose deadline expired while waiting —
+        or whose priority sits below the active brownout shed floor:
         terminal ``shed`` Result (empty tokens), counted against SLO
-        attainment. Requests without deadlines never shed. Cheap when
-        the queue carries no deadlines — one attribute scan, no
+        attainment. Requests without deadlines never deadline-shed
+        (brownout can still shed them). Cheap when the queue carries no
+        deadlines and no brownout is active — one attribute scan, no
         allocation (scheduler.drain_expired)."""
         if not self.sched.queued:
             return
         now = time.monotonic()
         meta = self._submit_meta
+        floor = self.brownout_min_priority
 
         def expired(req) -> bool:
-            return (req.deadline_s is not None
-                    and now - meta[req.rid][1] > req.deadline_s)
+            return ((req.deadline_s is not None
+                     and now - meta[req.rid][1] > req.deadline_s)
+                    or (floor is not None and req.priority < floor))
 
         for req in self.sched.drain_expired(expired):
             sub_step, sub_t, sid = meta.pop(req.rid)
@@ -1220,15 +1359,22 @@ class Engine:
             # pre-fault tokens, and the _Resume record must not leak.
             prompt_out, tokens_out, resumed = self._unstitch(
                 req.rid, req, [])
+            deadline_hit = (req.deadline_s is not None
+                            and now - sub_t > req.deadline_s)
             shed_fields = {"waited_s": round(now - sub_t, 6),
                            "deadline_s": req.deadline_s,
-                           "slo_class": req.slo_class}
+                           "slo_class": req.slo_class,
+                           "reason": ("deadline" if deadline_hit
+                                      else "brownout")}
             if resumed:
                 shed_fields["resumed"] = True
                 shed_fields["tokens"] = len(tokens_out)
             self.flight.record("shed", rid=req.rid, step=self.steps,
                                **shed_fields)
-            self.slo.record_shed(req.slo_class)
+            if req.deadline_s is not None:
+                self.slo.record_shed(req.slo_class)
+                if not deadline_hit:
+                    self.brownout_sheds += 1
             finished.append(Result(rid=req.rid, prompt=prompt_out,
                                    tokens=tokens_out,
                                    finish_reason="shed"))
@@ -1412,6 +1558,14 @@ class Engine:
             "shed": self.shed,
             "rejected": dict(self.rejected),
             "default_deadline_s": self.default_deadline_s,
+            # Scheduling endgame (ISSUE 13): preemption/chunk/brownout
+            # posture — what /debug/scheduler explains in detail.
+            "preemptions": self.preemptions,
+            "prefill_chunk": self.prefill_chunk,
+            "chunking": len(self._chunking),
+            "spec_suspended": self.spec_suspended,
+            "brownout": (None if self.brownout is None
+                         else self.brownout.stats()),
             # Fault/recovery posture (ISSUE 11): what readiness probes
             # and the /debug views key off, plus the armed fault plan
             # when chaos testing.
@@ -1571,11 +1725,19 @@ class Engine:
         inflight = dict(self._inflight[1]) if self._inflight is not None \
             else {}
         active = dict(self._active)
+        chunking = {e.slot: e for e in list(self._chunking)}
         slots = []
         for slot in range(self.num_slots):
             st = active.get(slot)
             if st is None:
-                slots.append({"slot": slot, "state": "free"})
+                e = chunking.get(slot)
+                if e is not None:
+                    slots.append({"slot": slot, "state": "prefilling",
+                                  "rid": e.req.rid,
+                                  "prompt_len": len(e.req.prompt),
+                                  "prefilled": e.hit + e.done})
+                else:
+                    slots.append({"slot": slot, "state": "free"})
                 continue
             req = st.req
             slots.append({
@@ -1608,10 +1770,13 @@ class Engine:
 
     def debug_scheduler(self) -> dict:
         """Queue composition head-first — per-request wait, deadline
-        state (the shed forecast), bucket — plus the admission ladders
-        and, under spec, the drafter's live acceptance."""
+        state (the shed forecast), bucket, priority — plus per-class
+        queue depths, the brownout posture, the chunked-prefill lane,
+        the admission ladders and, under spec, the drafter's live
+        acceptance."""
         now = time.monotonic()
         queued = []
+        by_class: Dict[str, dict] = {}
         for item in self.sched.queued_items():
             meta = self._submit_meta.get(item.rid)
             waited = None if meta is None else round(now - meta[1], 6)
@@ -1623,13 +1788,24 @@ class Engine:
                 # thread owns, nor touch its LRU clocks.
                 "bucket": self.sched.bucket_for(len(item.prompt)),
                 "slo_class": item.slo_class,
+                "priority": item.priority,
                 "deadline_s": item.deadline_s,
                 "waited_s": waited,
                 "expired": bool(item.deadline_s is not None
                                 and waited is not None
                                 and waited > item.deadline_s),
             })
+            # Per-priority counts, not one representative priority: a
+            # class can mix explicit overrides with its default, and an
+            # operator judging a brownout floor needs to see how much
+            # of the class sits on each side of it.
+            cls = by_class.setdefault(
+                item.slo_class, {"queued": 0, "priorities": {}})
+            cls["queued"] += 1
+            pr = cls["priorities"]
+            pr[item.priority] = pr.get(item.priority, 0) + 1
         out = {"queued": len(queued), "queue": queued,
+               "queue_by_class": by_class,
                "free_slots": self.sched.free_slots,
                "active": len(self._active),
                "prefill_buckets": list(self.sched.buckets),
@@ -1637,7 +1813,15 @@ class Engine:
                "pipeline": self.pipeline,
                "inflight_step": self._inflight is not None,
                "steps": self.steps, "shed": self.shed,
-               "default_deadline_s": self.default_deadline_s}
+               "default_deadline_s": self.default_deadline_s,
+               "preemptions": self.preemptions,
+               "prefill_chunk": self.prefill_chunk,
+               "chunking": [{"rid": e.req.rid, "slot": e.slot,
+                             "prefilled": e.hit + e.done,
+                             "prompt_len": len(e.req.prompt)}
+                            for e in list(self._chunking)],
+               "brownout": (None if self.brownout is None
+                            else self.brownout.stats())}
         if self._spec is not None:
             out["spec"] = self._spec.debug()
         return out
@@ -1654,50 +1838,99 @@ class Engine:
         hit = self.block_pool.match_len(req.prompt)
         return self.sched.bucket_for(len(req.prompt) - hit)
 
+    def _try_alloc(self, req):
+        """Reserve one request's KV blocks (paged engines): the
+        alloc_fail fault hook, the block_stall/block_reserve flight
+        events and the no-deadlock backpressure in one place — shared
+        by wave admission and the chunked-prefill lane. Returns the
+        Allocation, or None (request stays queued)."""
+        if (self.faults is not None
+                and self.faults.fire("alloc_fail", self.steps)
+                is not None):
+            # Forced exhaustion: the request stays queued (the normal
+            # no-deadlock backpressure), the stall is counted so the
+            # admission_stall watchdog sees the same signal a real one
+            # produces.
+            self.block_pool.stall_steps += 1
+            self.flight.record("fault", rid=req.rid, step=self.steps,
+                               site="alloc_fail")
+            return None
+        a = self.block_pool.admit(req.prompt, req.max_new_tokens)
+        if a is None:
+            self.flight.record(
+                "block_stall", rid=req.rid, step=self.steps,
+                need=self.block_pool.blocks_needed(
+                    len(req.prompt), req.max_new_tokens),
+                free=self.block_pool.free_blocks)
+            return None
+        self.flight.record("block_reserve", rid=req.rid,
+                           step=self.steps, blocks=len(a.table),
+                           hit_blocks=a.n_hit)
+        return a
+
     def _admit_waves(self, finished: List[Result]) -> None:
         import jax.numpy as jnp
 
+        budget = self.prefill_chunk
+        # Chunked-prefill lane first (ISSUE 13): requests mid-chunking
+        # are AHEAD of the queue in admission order — they were popped
+        # from its head — so they get first claim on this step's budget.
+        if budget is not None and self._chunking:
+            self._advance_chunked(finished)
         while True:
+            max_items: Optional[int] = None
+            if budget is not None:
+                if self._prefill_spent >= budget:
+                    break
+                head = self.sched.peek_head()
+                if head is None:
+                    break
+                if self.sched.free_slots:
+                    hb = (self._suffix_bucket(head) if self.paged
+                          else self.sched.bucket_for(len(head.prompt)))
+                    if self.paged and hb > budget:
+                        # Too long for one budgeted wave: route into the
+                        # chunked lane (paged prefill writes at
+                        # cache_index offsets, so the split costs no new
+                        # program). A block-starved head fences — FIFO.
+                        if not self._start_chunked(head):
+                            break
+                        self._advance_chunked(finished)
+                        continue
+                    # Cap the wave so rung * bucket fits the remaining
+                    # budget. A dense over-budget bucket (no way to
+                    # split) admits alone on a fresh step.
+                    remaining = budget - self._prefill_spent
+                    max_items = 0
+                    for r in self.admit_buckets:
+                        if r * hb <= remaining:
+                            max_items = r
+                    if max_items == 0:
+                        if self._prefill_spent:
+                            break       # resume on the next step
+                        max_items = 1
             allocs: List = []
             if self.paged:
 
                 def try_alloc(req):
-                    if (self.faults is not None
-                            and self.faults.fire("alloc_fail", self.steps)
-                            is not None):
-                        # Forced exhaustion: the request stays queued
-                        # (the normal no-deadlock backpressure), the
-                        # stall is counted so the admission_stall
-                        # watchdog sees the same signal a real one
-                        # produces.
-                        self.block_pool.stall_steps += 1
-                        self.flight.record("fault", rid=req.rid,
-                                           step=self.steps,
-                                           site="alloc_fail")
-                        return False
-                    a = self.block_pool.admit(req.prompt,
-                                              req.max_new_tokens)
+                    a = self._try_alloc(req)
                     if a is None:
-                        self.flight.record(
-                            "block_stall", rid=req.rid, step=self.steps,
-                            need=self.block_pool.blocks_needed(
-                                len(req.prompt), req.max_new_tokens),
-                            free=self.block_pool.free_blocks)
                         return False
                     allocs.append(a)
-                    self.flight.record("block_reserve", rid=req.rid,
-                                       step=self.steps,
-                                       blocks=len(a.table),
-                                       hit_blocks=a.n_hit)
                     return True
 
                 wave = self.sched.next_admission_wave(
+                    max_items=max_items,
                     bucket_of=self._suffix_bucket, admit=try_alloc)
             else:
-                wave = self.sched.next_admission_wave()
+                wave = self.sched.next_admission_wave(
+                    max_items=max_items)
             if wave is None:
                 break
             reqs, slots, bucket = wave
+            if budget is not None:
+                self._prefill_spent += (
+                    self.sched.rung_for(len(reqs)) * bucket)
             # From here until the admission commits, the wave is in
             # limbo: popped from the queue, blocks reserved, slots
             # claimed, but not yet active. Track it so a prefill crash
@@ -1783,59 +2016,10 @@ class Engine:
             self._rate_ring.append((now, len(reqs)))
             poisoned_wave = False
             for i, (req, slot) in enumerate(zip(reqs, slots)):
-                self.admitted += 1
-                first_tok = int(toks_host[i])
-                poisoned = not 0 <= first_tok < self.cfg.vocab_size
-                poisoned_wave = poisoned_wave or poisoned
-                resumed = req.rid in self._resumed
-                if not poisoned:
-                    self.tokens_generated += 1
-                sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
-                self._queue_wait.observe(self.steps - sub_step)
-                if not resumed and not poisoned:
-                    # A resumed request's first token predates the
-                    # recovery — re-observing submit->now as "TTFT"
-                    # would poison the spike watchdog's baseline; the
-                    # recovery histograms carry that latency instead.
-                    # A POISONED first token was discarded: its latency
-                    # describes nothing the client ever received.
-                    self._ttft.observe(now - sub_t)
-                    self.watchdog.on_ttft(now - sub_t)
-                alloc = allocs[i] if self.paged else None
-                hit_toks = (alloc.n_hit * self.kv_page_size
-                            if alloc is not None else 0)
-                if (self.paged and self.block_pool.cache is not None
-                        and not resumed and not poisoned):
-                    # The by-prefix-outcome TTFT split exists only when
-                    # the prefix cache does — a cache-less engine must
-                    # not mint placeholder {prefix=} series (the
-                    # /metrics label-hygiene rule).
-                    self._ttft_prefix.labels(
-                        prefix="hit" if hit_toks else "miss").observe(
-                            now - sub_t)
-                self.tracer.end(queued_sid,
-                                {"wait_steps": self.steps - sub_step})
-                self.flight.record("admit", rid=req.rid, step=self.steps,
-                                   slot=slot, bucket=bucket, rung=k,
-                                   wait_steps=self.steps - sub_step)
-                self.flight.record(
-                    "prefill", rid=req.rid, step=self.steps,
-                    prefix="hit" if hit_toks else "miss",
-                    hit_tokens=hit_toks,
-                    suffix_tokens=len(req.prompt) - hit_toks)
-                gen_sid = self.tracer.begin(
-                    "generate", cat="request", rid=req.rid,
-                    args={"slot": slot, "bucket": bucket})
-                st = _Active(req=req, slot=slot,
-                             tokens=[] if poisoned else [first_tok],
-                             first_token_t=now,
-                             submit_t=sub_t, last_t=now,
-                             span=gen_sid, alloc=alloc)
-                self._active[slot] = st
-                if not poisoned:
-                    done = self._maybe_finish(st)
-                    if done is not None:
-                        finished.append(done)
+                poisoned_wave |= self._commit_admission(
+                    req, slot, allocs[i] if self.paged else None,
+                    int(toks_host[i]), bucket=bucket, rung=k, now=now,
+                    finished=finished)
             # Wave committed: nothing is in limbo anymore.
             self._admitting = []
             self._admitting_span = None
@@ -1843,6 +2027,343 @@ class Engine:
                 self._mark_poison("poisoned_prefill",
                                   rids=[r.rid for r in reqs])
             self.tracer.end(wave_sid)
+
+    def _commit_admission(self, req: Request, slot: int, alloc,
+                          first_tok: int, *, bucket: int, rung: int,
+                          now: float, finished: List[Result]) -> bool:
+        """Per-request admission commit — the bookkeeping between the
+        first-token readback and the row going active, shared by wave
+        admission and the chunked-prefill lane's final chunk. Returns
+        whether the first token was poisoned (the caller aggregates
+        into one _mark_poison)."""
+        self.admitted += 1
+        poisoned = not 0 <= first_tok < self.cfg.vocab_size
+        resumed = req.rid in self._resumed
+        if not poisoned:
+            self.tokens_generated += 1
+        sub_step, sub_t, queued_sid = self._submit_meta.pop(req.rid)
+        self._queue_wait.observe(self.steps - sub_step)
+        if not resumed and not poisoned:
+            # A resumed request's first token predates the
+            # recovery/preemption — re-observing submit->now as "TTFT"
+            # would poison the spike watchdog's baseline; the recovery
+            # histograms carry that latency instead. A POISONED first
+            # token was discarded: its latency describes nothing the
+            # client ever received.
+            self._ttft.observe(now - sub_t)
+            self.watchdog.on_ttft(now - sub_t)
+        hit_toks = (alloc.n_hit * self.kv_page_size
+                    if alloc is not None else 0)
+        if (self.paged and self.block_pool.cache is not None
+                and not resumed and not poisoned):
+            # The by-prefix-outcome TTFT split exists only when the
+            # prefix cache does — a cache-less engine must not mint
+            # placeholder {prefix=} series (the /metrics label-hygiene
+            # rule).
+            self._ttft_prefix.labels(
+                prefix="hit" if hit_toks else "miss").observe(
+                    now - sub_t)
+        self.tracer.end(queued_sid,
+                        {"wait_steps": self.steps - sub_step})
+        self.flight.record("admit", rid=req.rid, step=self.steps,
+                           slot=slot, bucket=bucket, rung=rung,
+                           wait_steps=self.steps - sub_step)
+        self.flight.record(
+            "prefill", rid=req.rid, step=self.steps,
+            prefix="hit" if hit_toks else "miss",
+            hit_tokens=hit_toks,
+            suffix_tokens=len(req.prompt) - hit_toks)
+        gen_sid = self.tracer.begin(
+            "generate", cat="request", rid=req.rid,
+            args={"slot": slot, "bucket": bucket})
+        st = _Active(req=req, slot=slot,
+                     tokens=[] if poisoned else [first_tok],
+                     first_token_t=now,
+                     submit_t=sub_t, last_t=now,
+                     span=gen_sid, alloc=alloc)
+        self._active[slot] = st
+        if not poisoned:
+            done = self._maybe_finish(st)
+            if done is not None:
+                finished.append(done)
+        return poisoned
+
+    # ------------------------------------------------------------------
+    # chunked prefill (ISSUE 13): a long (suffix) prompt lands in the
+    # pool across several bucket-shaped dispatches, interleaved with
+    # decode steps — the admission pacing that keeps a prefill storm
+    # from stalling every active row's TPOT.
+    # ------------------------------------------------------------------
+    def _start_chunked(self, head: Request) -> bool:
+        """Claim the queue head into the chunked-prefill lane: blocks
+        reserved (full reservation — the no-deadlock contract), a slot
+        claimed (so traffic behind it cannot starve it out of one), no
+        device work yet. False when block-starved: the head stays
+        queued and fences admission, exactly like a starved wave."""
+        alloc = self._try_alloc(head)
+        if alloc is None:
+            return False
+        popped = self.sched.pop_head()
+        assert popped is head
+        slot = self.sched.take_slot()
+        self._chunking.append(_Chunking(
+            req=head, slot=slot, alloc=alloc,
+            hit=alloc.n_hit * self.kv_page_size))
+        return True
+
+    def _advance_chunked(self, finished: List[Result]) -> None:
+        """Dispatch prefill chunks for the in-progress chunked
+        admissions, oldest first, until this step's budget is spent.
+        Every chunk is an ordinary (1, bucket) prefill program — the
+        suffix-bucket grid already compiled — writing at cache_index =
+        hit + done (the prefix-hit machinery with a moving hit), so
+        intermediate chunks need no admit scatter and no readback: the
+        sampled token of an incomplete prompt is discarded unread. The
+        FINAL chunk passes the real slot and true_len, samples the
+        first token from fold_in(seed, true_len) — token-identical to a
+        monolithic prefill (pinned) — and commits the admission."""
+        import jax.numpy as jnp
+
+        budget = self.prefill_chunk
+        for entry in list(self._chunking):
+            while self._prefill_spent < budget:
+                req = entry.req
+                remaining = len(req.prompt) - entry.hit - entry.done
+                # Size the chunk to the REMAINING step budget, not the
+                # full one — waves admitted earlier this step already
+                # spent part of it, and a full-size chunk on top would
+                # run the step up to ~2x the TPOT-protection budget.
+                c = min(remaining, budget - self._prefill_spent)
+                bucket = self.sched.bucket_for(c)
+                if bucket > budget - self._prefill_spent:
+                    # Bucket padding would overshoot: resume on the
+                    # next step's fresh budget (which always fits one
+                    # full chunk — prefill_chunk is itself a bucket).
+                    # Same rule as the wave path's over-budget fence.
+                    return
+                final = c == remaining
+                start = entry.hit + entry.done
+                nb = self.slot_blocks
+                prompts = np.zeros((1, bucket), np.int32)
+                prompts[0, :c] = req.prompt[start:start + c]
+                meta = np.zeros((1, self._meta_width), np.int32)
+                meta[0, :nb] = self.kv_pool_blocks
+                meta[0, :len(entry.alloc.table)] = entry.alloc.table
+                # Intermediate chunks carry the sentinel slot id: only
+                # the final chunk's sampled token may reach the slot
+                # state (and only the final chunk is ever read back).
+                meta[0, nb] = entry.slot if final else self.num_slots
+                meta[0, nb + 1] = len(req.prompt) if final else start + c
+                meta[0, nb + 2] = req.top_k
+                meta[0, nb + 3] = req.seed
+                meta[0, nb + 4] = start
+                fmeta = np.zeros((1, 2), np.float32)
+                fmeta[0] = (req.temperature, req.top_p)
+                prompts_dev = jnp.asarray(prompts)
+                meta_dev = jnp.asarray(meta)
+                fmeta_dev = jnp.asarray(fmeta)
+                if (self.faults is not None
+                        and self.faults.fire("prefill_exc", self.steps)
+                        is not None):
+                    self.flight.record("fault", rid=req.rid,
+                                       step=self.steps,
+                                       site="prefill_exc")
+                    raise FaultInjected("prefill_exc", self.steps)
+                self._pool, toks = self._prefill(
+                    self.params, self._pool, prompts_dev, meta_dev,
+                    fmeta_dev)
+                self.host_dispatches["prefill"] += 1
+                self._prefill_spent += bucket
+                if (self._spec is not None
+                        and self._spec.drafter.kind == "device"):
+                    # The drafter's pool tracks the engine's chunk for
+                    # chunk (same staged arrays, same table) so a later
+                    # draft reads complete prompt K/V.
+                    self._spec.drafter.prefill_wave(prompts_dev, meta_dev)
+                entry.done += c
+                self.flight.record(
+                    "prefill_chunk", rid=req.rid, step=self.steps,
+                    n=c, prefilled=entry.hit + entry.done,
+                    of=len(req.prompt))
+                if not final:
+                    continue
+                # Final chunk: admit into the slot and commit.
+                self._state = self._admit(self._state, toks, meta_dev,
+                                          fmeta_dev)
+                self.host_dispatches["admit"] += 1
+                # jaxlint: disable=host-sync -- the admission first-token readback (same contract as the wave path)
+                first = int(np.asarray(toks)[0])
+                if (self.faults is not None
+                        and self.faults.fire("scatter_corrupt",
+                                             self.steps) is not None):
+                    self.flight.record("fault", step=self.steps,
+                                       site="scatter_corrupt")
+                    first = self.cfg.vocab_size
+                now = time.monotonic()
+                self._rate_ring.append((now, 1))
+                self._chunking.remove(entry)
+                if self._commit_admission(req, entry.slot, entry.alloc,
+                                          first, bucket=bucket, rung=1,
+                                          now=now, finished=finished):
+                    self._mark_poison("poisoned_prefill",
+                                      rids=[req.rid])
+                break
+            else:
+                return    # budget spent; later entries wait their turn
+
+    # ------------------------------------------------------------------
+    # priority preemption (ISSUE 13): when the head of the queue would
+    # miss its deadline waiting on slots or blocks, evict the lowest-
+    # priority active victim. The victim's blocks — prompt AND
+    # generated — are donated to the radix cache and it requeues with
+    # prompt' = prompt + tokens-so-far through the recovery _Resume
+    # path, so its resume is a prefix hit and greedy output is
+    # token-identical to an unpreempted run (pinned by test).
+    # ------------------------------------------------------------------
+    def _head_blocks_available(self, head: Request) -> bool:
+        """Could the head's reservation be covered right now (free +
+        evictable blocks, minus its prefix hit)? A pure probe — nothing
+        commits."""
+        need = self.block_pool.blocks_needed(len(head.prompt),
+                                             head.max_new_tokens)
+        hit_blocks = self.block_pool.match_len(head.prompt) \
+            // self.kv_page_size
+        avail = self.block_pool.free_blocks
+        if self.block_pool.cache is not None:
+            avail += self.block_pool.cache.evictable()
+        return need - hit_blocks <= avail
+
+    def _steps_per_s(self) -> Optional[float]:
+        """Recent dispatch rate in rate-ring entries/sec — the unit the
+        queue-wait histogram counts in, shared by the preemption policy
+        and the Retry-After hint so the two can never drift. None while
+        the signal is cold. list(deque): single C-level copy — callers
+        may run on an HTTP handler thread while the loop appends."""
+        ring = list(self._rate_ring)
+        if len(ring) < 2:
+            return None
+        dt = ring[-1][0] - ring[0][0]
+        if dt <= 0:
+            return None
+        return (len(ring) - 1) / dt
+
+    def _projected_slot_free_s(self) -> Optional[float]:
+        """Seconds until a slot frees NATURALLY: the smallest remaining
+        budget over active rows, converted through the recent PER-ROW
+        token rate. Token rate, not dispatch rate: under scan_k (or
+        spec) one retire lands several tokens per row, so dividing
+        remaining TOKENS by the dispatch rate would overestimate the
+        wait by the chunk length and preempt over-eagerly on exactly
+        the engines PR 12 sped up. None while the signal is cold."""
+        rate = self._recent_rate()
+        if rate is None or rate <= 0 or not self._active:
+            return None
+        per_row = rate / len(self._active)
+        min_rem = min(st.req.max_new_tokens - len(st.tokens)
+                      for st in self._active.values())
+        return max(0, min_rem) / per_row
+
+    def _maybe_preempt(self) -> None:
+        """One preemption per step, at most: evict the lowest-priority
+        active victim when the queue head (highest priority queued) is
+        blocked on slots/blocks AND its deadline slack no longer covers
+        the projected natural wait. Deadline-less heads never preempt
+        (there is no miss to prevent); equal-or-higher-priority victims
+        never exist by definition. Also hosts the ``preempt_storm``
+        fault site, which skips the policy and forces an eviction."""
+        if self.faults is not None and self._active:
+            f = self.faults.fire("preempt_storm", self.steps)
+            if f is not None:
+                victim = min(self._active.values(),
+                             key=lambda s: (s.req.priority, s.req.rid))
+                self.flight.record("fault", rid=victim.req.rid,
+                                   step=self.steps, site="preempt_storm")
+                self._preempt(victim, cause="preempt_storm")
+        if not self.preemption or not self._active:
+            return
+        head = self.sched.peek_head()
+        if head is None or head.deadline_s is None:
+            return
+        if self.sched.free_slots and (
+                self.block_pool is None
+                or self._head_blocks_available(head)):
+            return          # admissible this step without violence
+        meta = self._submit_meta.get(head.rid)
+        if meta is None:
+            return
+        now = time.monotonic()
+        waited = now - meta[1]
+        slack = head.deadline_s - waited
+        if slack <= 0:
+            return          # already doomed: it sheds — never waste a
+        #                     victim's work on a request past saving
+        proj = self._projected_slot_free_s()
+        if proj is not None:
+            if slack > proj:
+                return      # the natural wait still makes the deadline
+        elif waited < 0.5 * head.deadline_s:
+            # Cold rate signal: only preempt once the head has burned
+            # half its budget waiting — conservative, but deterministic.
+            return
+        victims = [st for st in self._active.values()
+                   if st.req.priority < head.priority]
+        if not victims:
+            return
+        # Lowest priority first; among equals the LATEST admission (the
+        # least sunk work — and its resume re-prefills the least).
+        victim = min(victims, key=lambda st: (st.req.priority,
+                                              -st.req.rid))
+        self._preempt(victim, cause="deadline")
+
+    def _preempt(self, st: _Active, cause: str) -> None:
+        """Evict one active request in favor of the queue: park its
+        slot, donate its prompt+generated blocks to the radix cache,
+        and requeue it at the HEAD of its priority class (requeue_front
+        — seniority preserved, same as a crash-recovery victim; it can
+        never bounce back and evict its evictor, whose priority is
+        strictly higher by the victim-selection rule) with prompt' =
+        prompt + tokens-so-far via the _Resume stitch, so the terminal
+        Result reads as one uninterrupted request. Not a terminal: no
+        ``evict``/``finish`` event — the fuzz pin holds."""
+        import jax.numpy as jnp
+
+        req = st.req
+        del self._active[st.slot]
+        self.sched.release(st.slot)
+        if self._inflight is not None:
+            # Drop the in-flight snapshot's claim on this slot: the
+            # victim's rid is about to re-enter the queue, and if it
+            # re-admits into the SAME slot before the lagged readback,
+            # the ride-along tokens would double-count. They are
+            # recomputed identically on resume (position-keyed
+            # sampling), so dropping them costs only the lane work.
+            self._inflight[1].pop(st.slot, None)
+        self._state = self._release(self._state,
+                                    jnp.asarray(st.slot, jnp.int32))
+        self.host_dispatches["release"] += 1
+        donated = 0
+        if st.alloc is not None:
+            donated = self.block_pool.release(st.alloc,
+                                              generated=st.tokens)
+        base = self._resumed.get(req.rid)
+        orig_prompt = base.prompt if base is not None else req.prompt
+        pre = (base.tokens if base is not None else []) + st.tokens
+        new_req = replace(req, prompt=req.prompt + tuple(st.tokens),
+                          max_new_tokens=req.max_new_tokens
+                          - len(st.tokens))
+        self._resumed[req.rid] = _Resume(prompt=orig_prompt, tokens=pre,
+                                         submit_t=st.submit_t)
+        self.tracer.end(st.span, {"preempted": True, "cause": cause})
+        sid = self.tracer.begin("queued", cat="request", rid=req.rid,
+                                args={"preempted": True})
+        self._submit_meta[req.rid] = (self.steps, st.submit_t, sid)
+        self.preemptions += 1
+        self.flight.record("preempt", rid=req.rid, step=self.steps,
+                           cause=cause, slot=st.slot,
+                           salvaged_tokens=len(pre),
+                           donated_blocks=donated,
+                           priority=req.priority)
+        self.sched.requeue_front([new_req])
 
     def _spec_step(self, finished: List[Result]) -> None:
         """One speculative round: collect per-row drafts (host prompt
@@ -2022,9 +2543,16 @@ class Engine:
                 rems.append(rem)
         if not rems:
             return 0
+        # Brownout level >= 1 caps the rung (serve/brownout.py): shorter
+        # chunks, finer admission interleaving, less finish-lag waste —
+        # a policy input only, never a new shape (the cap selects from
+        # the compiled ladder).
+        rungs = self.scan_rungs
+        if self.scan_cap is not None:
+            rungs = [r for r in rungs if r <= self.scan_cap] or rungs[:1]
         cost = self.scan_dispatch_cost_steps
         best, best_score = 1, -1.0
-        for r in self.scan_rungs:
+        for r in rungs:
             score = sum(min(rem, r) for rem in rems) / (cost + r)
             if score >= best_score:      # ties go to the larger rung
                 best, best_score = r, score
@@ -2415,6 +2943,18 @@ class Engine:
                 self.block_pool.release(alloc)
             base = self._resumed.get(req.rid)
             requeue.append((req, len(base.tokens) if base else 0, None))
+        for entry in self._chunking:
+            # A chunked prefill caught mid-pipeline: its blocks hold a
+            # PARTIALLY-written prompt, so they free without donation
+            # (a half-written chain must never serve a prefix hit);
+            # the request requeues as-is and re-chunks from scratch.
+            self.sched.release(entry.slot)
+            if entry.alloc is not None:
+                self.block_pool.release(entry.alloc, donate=False)
+            base = self._resumed.get(entry.req.rid)
+            requeue.append((entry.req,
+                            len(base.tokens) if base else 0, None))
+        self._chunking = []
         if flush_cache:
             from nanosandbox_tpu.models.gpt import (init_cache,
                                                     init_paged_cache)
@@ -2429,9 +2969,10 @@ class Engine:
                                         self.max_len,
                                         kv_dtype=self._kv_dtype_arg)
         self._state = self._fresh_slot_state()
-        # FIFO restoration: victims re-enter at the queue HEAD in rid
-        # (= original admission) order, ahead of traffic that arrived
-        # after them.
+        # FIFO restoration: victims re-enter at the head of their
+        # PRIORITY CLASS in rid (= original admission) order, ahead of
+        # same-class traffic that arrived after them but never jumping
+        # higher-priority queued requests.
         requeue.sort(key=lambda item: item[0].rid)
         now = time.monotonic()
         for req, done, sub_t in requeue:
@@ -2487,8 +3028,15 @@ class Engine:
             if alloc is not None:
                 self.block_pool.release(alloc)
             victims.append((req, slot, alloc, [], True))
+        for entry in self._chunking:
+            self.sched.release(entry.slot)
+            if entry.alloc is not None:
+                # Partially-written chain: free, never donate.
+                self.block_pool.release(entry.alloc, donate=False)
+            victims.append((entry.req, entry.slot, entry.alloc, [], True))
         self._active = {}
         self._admitting = []
+        self._chunking = []
         for req in self.sched.drain_expired(lambda item: True):
             victims.append((req, None, None, [], True))
         self._state = self._fresh_slot_state()
@@ -2509,18 +3057,40 @@ class Engine:
                            aborted=len(victims))
         return results
 
-    def retry_after_s(self) -> float:
+    def retry_after_s(self, slo_class: Optional[str] = None,
+                      priority: Optional[int] = None) -> float:
         """Client backoff hint for 429/503 responses: the scheduler's
         queue-wait p50 converted to wall seconds through the recent
         step rate (fallback 1s when either signal is cold) — a shed
         client that waits this long lands where today's admitted
-        traffic is actually clearing the queue."""
+        traffic is actually clearing the queue.
+
+        Priority-aware (ISSUE 13): under the priority queue a batch
+        request waits behind EVERYTHING at or above its class, so its
+        hint scales with the queue mass ahead of it — a batch client
+        behind a deep interactive queue no longer gets an interactive
+        client's optimistic number. ``slo_class`` maps through
+        PRIORITY_BY_CLASS when ``priority`` is not given; with neither,
+        the classless base estimate is returned (the pre-priority
+        behavior)."""
+        base = 1.0
         p = self._queue_wait.percentiles((50,))
-        ring = list(self._rate_ring)
-        if p and p.get("p50") is not None and len(ring) >= 2:
-            dt = ring[-1][0] - ring[0][0]
-            if dt > 0:
-                steps_per_s = (len(ring) - 1) / dt
-                if steps_per_s > 0:
-                    return max(0.5, p["p50"] / steps_per_s)
-        return 1.0
+        steps_per_s = self._steps_per_s()
+        if p and p.get("p50") is not None and steps_per_s is not None:
+            base = max(0.5, p["p50"] / steps_per_s)
+        if priority is None:
+            if slo_class is None:
+                return base
+            priority = PRIORITY_BY_CLASS.get(slo_class, DEFAULT_PRIORITY)
+        # Everything at-or-above the class waits ahead of it; STRICTLY
+        # higher backlog counts double — its depth is the best available
+        # proxy for the arrival pressure that will keep jumping this
+        # class after it requeues (and, with deadlines, preempting it).
+        ahead = jumps = 0
+        for item in self.sched.queued_items():
+            p = getattr(item, "priority", DEFAULT_PRIORITY)
+            if p >= priority:
+                ahead += 1
+            if p > priority:
+                jumps += 1
+        return base * (1.0 + (ahead + jumps) / max(1, self.num_slots))

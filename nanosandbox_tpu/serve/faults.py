@@ -34,6 +34,14 @@ Sites (where the engine consults the plan — see Engine for the hooks):
                   mid-admission crash with blocks already committed,
                   the hardest recovery case (the wave is in limbo:
                   popped from the queue, not yet active).
+  preempt_storm   the scheduler is forced to preempt its lowest-
+                  priority active victim regardless of any deadline
+                  pressure (ISSUE 13) — repeated firings keep evicting
+                  the SAME victim as it re-admits, pinning that
+                  preemption-resume (blocks donated, prompt' = prompt +
+                  tokens so far) composes with recovery and still
+                  yields token-identical outputs and exactly-once
+                  terminals.
 
 Plans are enabled only by the explicit ``Engine(faults=...)`` /
 ``bench.py --faults=...`` hook: with no plan attached every site check
@@ -49,21 +57,23 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 SITES = ("nan_logits", "slow_step", "alloc_fail", "drafter_fault",
-         "scatter_corrupt", "prefill_exc")
+         "scatter_corrupt", "prefill_exc", "preempt_storm")
 
 # Named plans for CI smoke jobs and drills: steps are RELATIVE to the
 # last (re)arm, so `plan.rearm(engine.steps)` after warmup aims the
 # whole schedule at the measured window.
 CANNED = {
-    # One poisoned decode step, a burst of allocation failures, and a
-    # mid-admission prefill crash — the three recovery classes (poison
-    # rebuild, backpressure-no-rebuild, exception rebuild-with-flush)
+    # One poisoned decode step, a burst of allocation failures, a
+    # mid-admission prefill crash, and a repeated-preemption storm —
+    # the three recovery classes (poison rebuild, backpressure-no-
+    # rebuild, exception rebuild-with-flush) plus preemption-resume,
     # early enough that short --quick runs hit all of them.
-    "chaos-smoke": "nan_logits@6,alloc_fail@10x6,prefill_exc@18",
+    "chaos-smoke": ("nan_logits@6,alloc_fail@10x6,prefill_exc@18,"
+                    "preempt_storm@22x3"),
     # Every class incl. a drafter failure streak and a second poison —
     # for manual drills against a spec-enabled engine.
     "chaos-full": ("nan_logits@6,drafter_fault@10x4,prefill_exc@20,"
-                   "alloc_fail@28x8,nan_logits@40"),
+                   "alloc_fail@28x8,preempt_storm@34x3,nan_logits@40"),
 }
 
 

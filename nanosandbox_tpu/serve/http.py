@@ -243,14 +243,18 @@ def make_server(host: str, port: int, loop: EngineLoop,
 
     POST /generate  {"prompt": str | "prompt_tokens": [int], and any of
                      max_new_tokens, temperature, top_k, top_p, seed,
-                     eos_id, deadline_s, slo_class}  ->  {"id",
-                     "tokens", "text", "finish_reason"}. deadline_s
-                     arms SLO accounting + queue-time shedding; a shed
-                     request returns 429 with a Retry-After derived
-                     from the queue-wait p50; a request lost to
-                     permanent engine failure returns 503 with its
-                     partial tokens. Every response's status lands in
-                     the flight recorder as an ``http`` event.
+                     eos_id, deadline_s, slo_class, priority}  ->
+                     {"id", "tokens", "text", "finish_reason"}.
+                     deadline_s arms SLO accounting + queue-time
+                     shedding; slo_class/priority order the scheduler
+                     queue (interactive > default > batch) and decide
+                     preemption; a shed request returns 429 with its
+                     class and a Retry-After derived from the
+                     queue-wait p50 scaled by the queue mass ahead of
+                     that class; a request lost to permanent engine
+                     failure returns 503 with its partial tokens. Every
+                     response's status lands in the flight recorder as
+                     an ``http`` event.
     POST /drain     begin graceful drain (idempotent): in-flight work
                      finishes, new /generate gets 503 + Retry-After,
                      readiness goes red. The k8s preStop hook calls
@@ -312,9 +316,13 @@ def make_server(host: str, port: int, loop: EngineLoop,
 
     loop_reg.add_collector(_collect_loop)
 
-    def _retry_after() -> int:
+    def _retry_after(slo_class=None) -> int:
+        # Priority-aware (ISSUE 13): a shed batch request behind a deep
+        # interactive queue gets a hint scaled by the queue mass ahead
+        # of its class, not the interactive client's optimistic number.
         try:
-            return max(1, math.ceil(loop.engine.retry_after_s()))
+            return max(1, math.ceil(
+                loop.engine.retry_after_s(slo_class=slo_class)))
         except Exception:
             return 1
 
@@ -340,14 +348,16 @@ def make_server(host: str, port: int, loop: EngineLoop,
 
         def _gen_respond(self, code: int, obj: dict,
                          rid: Optional[int] = None,
-                         retry_after: bool = False) -> None:
+                         retry_after: bool = False,
+                         slo_class: Optional[str] = None) -> None:
             """/generate response with status hygiene: the flight
             recorder keeps what the client was told, 429/503 carry a
-            Retry-After the client can actually obey."""
+            Retry-After the client can actually obey (scaled by the
+            requester's priority class when known)."""
             fl = getattr(loop.engine, "flight", None)
             if fl is not None:
                 fl.record("http", rid=rid, status=code)
-            headers = ({"Retry-After": _retry_after()}
+            headers = ({"Retry-After": _retry_after(slo_class)}
                        if retry_after else None)
             self._json(code, obj, headers=headers)
 
@@ -494,6 +504,8 @@ def make_server(host: str, port: int, loop: EngineLoop,
                     kwargs["deadline_s"] = float(payload["deadline_s"])
                 if payload.get("slo_class") is not None:
                     kwargs["slo_class"] = str(payload["slo_class"])
+                if payload.get("priority") is not None:
+                    kwargs["priority"] = int(payload["priority"])
             except (ValueError, TypeError, KeyError,
                     json.JSONDecodeError) as e:
                 # KeyError: a char tokenizer raises it for prompt chars
@@ -517,18 +529,21 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 self._gen_respond(503, {"error": str(e)})
                 return
             if res.finish_reason == "shed":
-                # Deadline expired in the queue: the engine is healthy,
-                # THIS request's patience ran out — 429, try again when
-                # the queue has cleared (Retry-After says when). tokens
-                # are non-empty only for a recovery-requeued victim
-                # whose deadline expired awaiting re-admission (the
-                # salvaged pre-fault output).
+                # Deadline expired in the queue (or the brownout ladder
+                # is shedding this class): the engine is healthy, THIS
+                # request lost — 429, try again when the queue has
+                # cleared (Retry-After says when, scaled by the
+                # requester's class). tokens are non-empty only for a
+                # recovery/preemption-requeued victim shed awaiting
+                # re-admission (the salvaged pre-fault output).
+                cls = kwargs.get("slo_class", "default")
                 self._gen_respond(
                     429, {"error": "shed: deadline expired in the "
-                                   "queue",
+                                   "queue (or brownout shed)",
                           "id": res.rid, "tokens": res.tokens,
-                          "finish_reason": "shed"},
-                    rid=res.rid, retry_after=True)
+                          "finish_reason": "shed",
+                          "slo_class": cls},
+                    rid=res.rid, retry_after=True, slo_class=cls)
                 return
             if res.finish_reason == "failed":
                 # Permanent engine failure drained this request: the

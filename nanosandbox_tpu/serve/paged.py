@@ -60,7 +60,8 @@ def blocks_for(n_positions: int, page: int) -> int:
 class _Node:
     """One cached block: a trie edge keyed by its page of token ids."""
 
-    __slots__ = ("key", "block", "parent", "children", "refs", "last_use")
+    __slots__ = ("key", "block", "parent", "children", "refs",
+                 "last_use", "locks")
 
     def __init__(self, key, block: int, parent):
         self.key = key                  # tuple of page token ids
@@ -69,6 +70,8 @@ class _Node:
         self.children: Dict[tuple, "_Node"] = {}
         self.refs = 0                   # in-flight requests sharing it
         self.last_use = 0
+        self.locks = 0                  # refs>0 nodes in this subtree
+        #                                 (incl. self): evictable while 0
 
 
 class RadixPrefixCache:
@@ -86,6 +89,7 @@ class RadixPrefixCache:
         self.root = _Node(key=None, block=-1, parent=None)
         self._nodes: List[_Node] = []   # every live node (small pools)
         self._tick = 0
+        self._evictable = 0             # nodes with locks == 0
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -113,13 +117,34 @@ class RadixPrefixCache:
             n.last_use = self._tick
         return path
 
+    def _lock_chain(self, node: _Node, d: int) -> None:
+        """Propagate a refs 0<->1 transition up the ancestor chain.
+        ``locks`` counts pinned (refs>0) nodes per subtree, so a node
+        is evictable exactly while locks == 0 — maintained here (O(depth)
+        per transition) so ``evictable()`` is an O(1) read on the
+        engine's per-step preemption probe instead of an O(all-nodes)
+        pinned-set walk."""
+        n = node
+        while n is not None:
+            n.locks += d
+            if n.parent is not None:    # root is not a pool block
+                if d > 0 and n.locks == 1:
+                    self._evictable -= 1
+                elif d < 0 and n.locks == 0:
+                    self._evictable += 1
+            n = n.parent
+
     def acquire(self, node: _Node) -> None:
         node.refs += 1
+        if node.refs == 1:
+            self._lock_chain(node, +1)
 
     def release(self, node: _Node) -> None:
         if node.refs <= 0:
             raise RuntimeError("prefix-cache refcount underflow")
         node.refs -= 1
+        if node.refs == 0:
+            self._lock_chain(node, -1)
 
     def insert_chain(self, prompt: Sequence[int], blocks: Sequence[int],
                      start: int) -> List[int]:
@@ -142,6 +167,7 @@ class RadixPrefixCache:
                 child = _Node(keys[i], blocks[i], node)
                 node.children[keys[i]] = child
                 self._nodes.append(child)
+                self._evictable += 1    # refs 0, no children: locks 0
             else:
                 dup.append(blocks[i])
             child.last_use = self._tick
@@ -151,14 +177,12 @@ class RadixPrefixCache:
     def evictable(self) -> int:
         """Blocks reclaimable RIGHT NOW by repeated leaf eviction: nodes
         with refs == 0 and no pinned descendant (a refs-0 parent of a
-        pinned child must stay — the child's prefix walk crosses it)."""
-        pinned = set()
-        for n in self._nodes:
-            if n.refs > 0:
-                while n is not None and id(n) not in pinned:
-                    pinned.add(id(n))
-                    n = n.parent
-        return sum(1 for n in self._nodes if id(n) not in pinned)
+        pinned child must stay — the child's prefix walk crosses it).
+        An O(1) counter read: the engine's preemption check probes this
+        every step under block pressure, so the count is maintained
+        incrementally on the refs 0<->1 transitions (_lock_chain) and
+        audited against the O(n) recompute in pool _audit."""
+        return self._evictable
 
     def evict(self, want: int) -> List[int]:
         """Free up to ``want`` blocks, LRU refcount-zero leaves first
@@ -175,6 +199,7 @@ class RadixPrefixCache:
                 break
             del victim.parent.children[victim.key]
             self._nodes.remove(victim)
+            self._evictable -= 1        # victims are locks-0 by choice
             freed.append(victim.block)
         return freed
 
@@ -298,21 +323,41 @@ class BlockPool:
                           table=[n.block for n in nodes] + fresh,
                           n_hit=n_hit, nodes=nodes)
 
-    def release(self, alloc: Allocation) -> None:
+    def release(self, alloc: Allocation, *,
+                generated: Sequence[int] = (),
+                donate: bool = True) -> int:
         """Unwind one finished request: deref its hit chain, donate its
         full prompt blocks to the trie, free the rest (generated-region
-        blocks + donation duplicates)."""
+        blocks + donation duplicates). Returns the number of blocks
+        newly donated (the preempt flight event's ledger).
+
+        ``generated`` (ISSUE 13, the preemption path) extends the
+        donation to the request's full prompt+generated blocks, so a
+        preempted victim's resume — prompt' = prompt + tokens-so-far —
+        is a prefix HIT over its own decode-written K/V instead of a
+        full re-prefill. The LAST generated token's K/V is excluded: it
+        was only ever sampled, never consumed as a decode input, so its
+        position is unwritten (and the radix match's one-token-short
+        cap means no future hit could use it anyway).
+
+        ``donate=False`` frees everything instead — the unwind for a
+        PARTIALLY-prefilled allocation (a chunked prefill interrupted
+        by a crash): donating a half-written prompt chain would serve
+        garbage K/V as a prefix hit."""
         for n in alloc.nodes:
             self.cache.release(n)
-        full = len(alloc.prompt) // self.page
-        if self.cache is not None:
-            dup = self.cache.insert_chain(alloc.prompt, alloc.table,
-                                          alloc.n_hit)
-            self._free.extend(dup)
-            self._free.extend(alloc.table[full:])
-            self._match_memo = None
-        else:
+        if self.cache is None or not donate:
             self._free.extend(alloc.table[alloc.n_hit:])
+            return 0
+        tokens = tuple(alloc.prompt) + tuple(generated)
+        written = len(tokens) - (1 if generated else 0)
+        full = written // self.page
+        dup = self.cache.insert_chain(tokens[:full * self.page],
+                                      alloc.table, alloc.n_hit)
+        self._free.extend(dup)
+        self._free.extend(alloc.table[full:])
+        self._match_memo = None
+        return full - alloc.n_hit - len(dup)
 
     def reset_cache(self) -> None:
         """Evict every cached block back to the free list and zero the
@@ -449,3 +494,15 @@ class BlockPool:
             for n in self.cache._nodes:
                 assert n.refs == refs.get(id(n), 0), (
                     "refcount drift", n.key, n.refs, refs.get(id(n), 0))
+            # The O(1) evictable counter vs the O(n) pinned-set walk it
+            # replaced — any _lock_chain bookkeeping drift fails here.
+            pinned: set = set()
+            for n in self.cache._nodes:
+                if n.refs > 0:
+                    while n is not None and id(n) not in pinned:
+                        pinned.add(id(n))
+                        n = n.parent
+            slow = sum(1 for n in self.cache._nodes
+                       if id(n) not in pinned)
+            assert self.cache._evictable == slow, (
+                "evictable-counter drift", self.cache._evictable, slow)

@@ -31,6 +31,15 @@ self-healing instead of a dead process:
   watchdog trip     stuck_slot / stalled_step — a wedge with no
                     exception to catch: same quarantine + rebuild.
 
+Preemption (ISSUE 13) composes with all three classes: a victim evicted
+by the priority scheduler sits in the queue as prompt' = prompt +
+tokens-so-far behind the same _Resume stitch recovery uses, so a fault
+landing between its eviction and its re-admission just requeues it
+again — one terminal, token-identical output, pinned by the
+preempt_storm chaos tests.  Requests mid-CHUNKED-prefill are unwound
+like mid-wave limbo: blocks freed WITHOUT donation (their prompt chain
+is only partially written) and the request re-chunks from scratch.
+
 Recovery attempts back off exponentially (base * 2^(n-1), capped), and
 ``max_consecutive`` failures inside ``settle_s`` escalate to PERMANENT
 failure: the engine drains cleanly (every in-flight/queued request gets

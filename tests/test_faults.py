@@ -311,15 +311,20 @@ def test_exactly_once_terminals_under_recovery_fuzz(served_model):
     """Fuzz the no-orphan contract across paged/dense/spec mixes with
     faults landing mid-flight: every request reaches EXACTLY one
     terminal, and no evict is orphaned (every evicted rid finishes,
-    exactly once — interrupted requests are requeued, not evicted)."""
+    exactly once — interrupted requests are requeued, not evicted).
+    The plan includes a preempt_storm burst (ISSUE 13): preempted-then-
+    finished requests must emit one terminal and zero orphaned evicts
+    too — a preemption is a requeue, never a terminal."""
     cfg, model, params = served_model
     cases = [
         dict(paged=True),
         dict(paged=False),
         dict(paged=True, spec=NGramDrafter(k=3)),
     ]
+    preempts = 0
     for i, case in enumerate(cases):
-        plan = FaultPlan.parse("nan_logits@3,prefill_exc@9,nan_logits@15")
+        plan = FaultPlan.parse("nan_logits@3,prefill_exc@9,"
+                               "preempt_storm@12x2,nan_logits@15")
         eng = Engine(model, params, num_slots=4, max_len=64,
                      faults=plan, **case)
         sup = EngineSupervisor(eng, backoff_base_s=0.0)
@@ -328,6 +333,7 @@ def test_exactly_once_terminals_under_recovery_fuzz(served_model):
         rids.append(eng.submit([2, 3], 0))          # zero-token terminal
         got = _drive(sup)
         assert plan.fired_log, case
+        preempts += eng.preemptions
         events = eng.flight.events()
         for rid in rids:
             terms = [e for e in events if e.get("rid") == rid
@@ -339,6 +345,8 @@ def test_exactly_once_terminals_under_recovery_fuzz(served_model):
             if evicts:
                 assert terms[0]["ev"] == "finish", (case, rid)
         assert set(got) == set(rids)
+    assert preempts >= 1, "preempt_storm never fired — the extension " \
+                          "pinned nothing"
 
 
 # --------------------------------------------------- graceful degradation
