@@ -17,7 +17,7 @@ Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
            [--max_new_tokens=N] [--requests=N] [--mixed=1] \
            [--paged={on,off}] [--prefix_share=F] [--kv_page_size=N] \
            [--scan_k=N] [--kv_dtype={fp32,bf16,int8,int4}] \
-           [--baseline_kv_dtype=MODE] [--decode_impl=IMPL] \
+           [--baseline_kv_dtype=MODE] [--decode_impl=IMPL] [--tp=N] \
            [--spec={off,ngram}] [--spec_k=N] [--repetitive] [--repeat=N] \
            [--emit_obs]
        python bench.py --mode=serve [--quick] [--num_slots=N] \
@@ -198,6 +198,27 @@ def estimate_decode_hbm_bytes_per_token(cfg, *, num_slots: int,
     return int(param_bytes / num_slots + kv_bytes)
 
 
+def _tp_collective_bytes_per_token(engine):
+    """Model-axis collective bytes one decode dispatch moves per
+    generated token: the engine's own rung-1 decode program is
+    AOT-lowered under its live mesh and parsed by the shardcheck
+    manifest machinery — the exact number budgets/serve_tp_cpu8.json
+    pins, surfaced in the bench JSON next to the throughput it buys.
+    Rung 1 emits one token per dispatch, so program bytes == bytes per
+    token. None when the analysis backend can't lower (never fails the
+    bench)."""
+    try:
+        from nanosandbox_tpu.analysis.shardcheck.manifest import (
+            analyze_program)
+
+        spec = next(s for s in engine.shardcheck_programs(engine.mesh)
+                    if not s.name.startswith("decode_scan")
+                    and s.name.startswith("decode"))
+        return analyze_program(spec, engine.mesh)["totals"]["bytes_moved"]
+    except Exception:
+        return None
+
+
 def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     """Batched-decode tokens/sec through the serve engine, pipelined vs
     synchronous.
@@ -301,6 +322,14 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     # parity pinned at 1.0 and dispatches_per_token measured (the
     # ISSUE-12 <= 0.15 bar).
     scan_k = int(kv.get("scan_k", 1))
+    # --tp=N: the primary engines shard over N chips (ISSUE 14 — the
+    # Megatron weights + heads-sharded KV pool engine); a tp=1 twin
+    # rides the SAME interleaved rotated rounds so tp_vs_single_toks is
+    # attributable to the sharding alone, tp_greedy_parity is pinned at
+    # 1.0 (same keys, same per-row math, deterministic collectives),
+    # and collective_bytes_per_token comes from AOT-lowering the
+    # engine's own decode program (the number the TP budget pins).
+    tp = int(kv.get("tp", 1))
     # int4-vs-int8 capacity twin: at equal VALUE bytes an int4 pool
     # holds 2x the blocks of an int8 one, so when the baseline mode is
     # int8 the primary int4 engines get a 2x-block pool — the
@@ -355,11 +384,12 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             engine.submit(prompt, mnt)
 
     def build(pipeline: bool, drafter=None, kvd=kv_dtype, pg=paged,
-              sk=scan_k, impl=decode_impl, pool_blocks=None):
+              sk=scan_k, impl=decode_impl, pool_blocks=None, tpn=tp):
         engine = Engine(model, params, num_slots=num_slots, max_len=max_len,
                         pipeline=pipeline, spec=drafter, kv_dtype=kvd,
                         decode_impl=impl, paged=pg, scan_k=sk,
-                        kv_page_size=kv_page, kv_pool_blocks=pool_blocks)
+                        kv_page_size=kv_page, kv_pool_blocks=pool_blocks,
+                        tp=tpn)
         # Warmup: every (wave rung, bucket) prefill + admit + decode +
         # release program, so no timed window eats an XLA compile. The
         # prompt length must MAP to the bucket being warmed (in
@@ -426,6 +456,11 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
         # dispatch amortization.
         engines["scan1"] = build(pipeline=True, sk=1,
                                  pool_blocks=pool_blocks_primary)
+    if tp > 1:
+        # The tp=1 twin: same pool layout/bytes, same workload seeds,
+        # same rotated rounds — the ratio isolates the sharding.
+        engines["tp1"] = build(pipeline=True, tpn=1,
+                               pool_blocks=pool_blocks_primary)
     if paged:
         # The dense-pool twin rides the SAME interleaved rounds and
         # workload seeds: paged_vs_dense_toks is then attributable to
@@ -601,6 +636,25 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "single_step_dispatches_per_token": scan1_dpt,
         })
 
+    # Tensor-parallel signal (ISSUE 14): tokens/sec vs the tp=1 twin,
+    # greedy parity (pinned 1.0 — the sharding is a layout choice, not
+    # sampling state), and the model-axis collective bytes one decode
+    # dispatch moves per generated token, from AOT-lowering the
+    # engine's own rung-1 decode program under its live mesh — the
+    # same machinery (and the same number) the committed TP budget
+    # pins in CI.
+    tp_extra = {"tp": tp}
+    if tp > 1:
+        tp1_rate = median(rates["tp1"])
+        tp_extra.update({
+            "tp1_tokens_per_sec": tp1_rate,
+            "tp_vs_single_toks": rate / tp1_rate,
+            "tp_greedy_parity": greedy_parity(tokens_by_engine["pipe"],
+                                              tokens_by_engine["tp1"]),
+            "collective_bytes_per_token":
+                _tp_collective_bytes_per_token(engines["pipe"]),
+        })
+
     # Paged-prefill kernel vs the gathered XLA fallback, as an isolated
     # single-request TTFT probe (throughput rounds bury prefill inside
     # queueing): only meaningful when the primary engines actually run
@@ -753,6 +807,7 @@ def bench_decode(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "queue_wait_steps_mean": stats["queue_wait_steps_mean"],
             "repetitive": repetitive,
             **scan_extra,
+            **tp_extra,
             **kv_extra,
             **paged_extra,
             **spec_extra,
@@ -1280,6 +1335,19 @@ def main(argv: list[str]) -> dict:
         kv.setdefault("emit_obs", "1")
     if "--sched" in argv:
         kv.setdefault("sched", "1")
+    if kv.get("mode") == "decode" and int(kv.get("tp", 1)) > 1 \
+            and "jax" not in sys.modules:
+        # --tp on a CPU-only install needs virtual host devices, and the
+        # flag must land before jax initializes its backend. Harmless on
+        # accelerators — it only sizes the host CPU platform, and the
+        # engine shards over jax.devices() (the accelerator list there).
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{max(8, int(kv['tp']))}").strip()
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -25,6 +25,21 @@ them, at the source level, before anything compiles:
                          just another axis label until mesh-bind time,
                          when it fails far from the typo (or worse,
                          a stale name silently stops sharding).
+  unconstrained-frontier-slice
+                         a traced-offset ``lax.dynamic_slice`` /
+                         ``dynamic_slice_in_dim`` whose operand was
+                         never REBOUND through
+                         ``with_sharding_constraint`` in the same
+                         function, in a mesh-aware module: if that
+                         operand is sharded along the sliced dim,
+                         GSPMD can only satisfy the data-dependent
+                         offset by all-gathering the WHOLE operand on
+                         every device — the shardcheck
+                         ``frontier_slice`` fixture's accident, and
+                         the exact footgun a sharded KV pool is one
+                         dropped constraint away from. Constrain the
+                         operand off the sliced dim first (the fixture
+                         shows the idiom).
 
 Like every jaxlint rule this file is pure ast — the axis registry is
 MIRRORED here (jaxlint must run without jax installed) and a test pins
@@ -135,6 +150,112 @@ class ImplicitReplicationRule(Rule):
                     "mesh-aware module — this lands the value "
                     "replicated/single-device and the first sharded "
                     "consumer pays the reshard; pass a NamedSharding"))
+        return out
+
+
+@register
+class UnconstrainedFrontierSliceRule(Rule):
+    id = "unconstrained-frontier-slice"
+    doc = ("a traced-offset dynamic_slice/dynamic_slice_in_dim on a "
+           "value never rebound through with_sharding_constraint in a "
+           "mesh-aware module — on an operand sharded along the sliced "
+           "dim GSPMD satisfies the data-dependent offset by "
+           "all-gathering the WHOLE operand (the shardcheck "
+           "frontier_slice fixture's accident); constrain the operand "
+           "off the sliced dim first")
+
+    # Same scope heuristic as implicit-replication: only modules that
+    # visibly work with meshes — a single-chip script's dynamic_slice
+    # has nothing to gather.
+    _MESH_MARKERS = ("NamedSharding", "make_mesh", "make_hybrid_mesh",
+                     "Mesh(")
+    _SLICE_NAMES = ("dynamic_slice", "dynamic_slice_in_dim")
+
+    @staticmethod
+    def _own_nodes(fn) -> List[ast.AST]:
+        """Nodes belonging to ``fn`` itself, nested function bodies
+        excluded — a constraint applied inside a sibling closure must
+        not launder a slice in this one (the fixture pair lives as two
+        nested functions of one builder, and only ONE of them
+        constrains)."""
+        out: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            out.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not any(m in ctx.source for m in self._MESH_MARKERS):
+            return []
+        out: List[Finding] = []
+        funcs = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        def is_wsc(value) -> bool:
+            return (isinstance(value, ast.Call) and terminal_name(
+                value.func) == "with_sharding_constraint")
+
+        def is_static(a) -> bool:
+            return (isinstance(a, ast.Constant)
+                    or (isinstance(a, (ast.Tuple, ast.List))
+                        and all(isinstance(e, ast.Constant)
+                                for e in a.elts)))
+
+        for fn in funcs:
+            nodes = self._own_nodes(fn)
+            constrained: Set[str] = set()
+            for node in nodes:
+                # with_sharding_constraint is FUNCTIONAL — the
+                # constrained value is its RESULT, so credit the
+                # assignment TARGET (`pool = wsc(pool, ...)` or the
+                # rebind `pool_c = wsc(pool, ...)`), never the argument:
+                # a discarded-result call constrains nothing.
+                targets = []
+                if isinstance(node, ast.Assign) and is_wsc(node.value):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None and is_wsc(node.value):
+                    targets = [node.target]
+                elif isinstance(node, ast.NamedExpr) and is_wsc(node.value):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        constrained.add(t.id)
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                if terminal_name(node.func) not in self._SLICE_NAMES:
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Name):
+                    continue       # only bare names are trackable
+                operand = node.args[0].id
+                if operand in constrained:
+                    continue
+                # Static start indices slice a fixed window — GSPMD
+                # partitions those without materializing anything; only
+                # a TRACED offset forces the gather. The offset may
+                # arrive positionally or as a keyword (start_index /
+                # start_indices); an empty candidate list means we
+                # could not FIND the offset — treat as traced, never
+                # vacuously static.
+                starts = list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("start_index", "start_indices")]
+                if starts and all(is_static(a) for a in starts):
+                    continue
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    f"traced-offset {terminal_name(node.func)} on "
+                    f"`{operand}`, which no with_sharding_constraint "
+                    "touched in this function — if it is sharded along "
+                    "the sliced dim, GSPMD all-gathers the whole "
+                    "operand on every device to satisfy the offset "
+                    "(the shardcheck frontier_slice accident); "
+                    "constrain it off the sliced dim first"))
         return out
 
 

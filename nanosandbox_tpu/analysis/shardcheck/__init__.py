@@ -30,10 +30,11 @@ from nanosandbox_tpu.analysis.shardcheck.budget import (budget_from_manifest,
                                                         write_budget)
 from nanosandbox_tpu.analysis.shardcheck.manifest import (
     Expectations, ProgramSpec, analyze_program, axis_groups,
-    build_manifest, export_manifest_metrics, provenance,
-    render_manifest_text)
+    build_manifest, export_collective_bytes_per_token,
+    export_manifest_metrics, provenance, render_manifest_text)
 
 __all__ = ["Expectations", "ProgramSpec", "analyze_program", "axis_groups",
            "build_manifest", "render_manifest_text", "provenance",
-           "export_manifest_metrics", "budget_from_manifest",
+           "export_manifest_metrics", "export_collective_bytes_per_token",
+           "budget_from_manifest",
            "check_budget", "load_budget", "write_budget"]

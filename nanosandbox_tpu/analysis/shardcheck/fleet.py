@@ -9,9 +9,14 @@ Two committed fleets, one per budget file:
     ``budgets/train_cpu8.json``.
   * ``serve`` — a tiny Engine with a ModelDrafter: decode, the
     prefill ladder x bucket grid, spec verify, drafter draft +
-    draft_prefill grid, everything REPLICATED on the mesh (today's
+    draft_prefill grid, everything REPLICATED on the mesh (the
     single-chip contract stated explicitly) so the budget pins zero
     collectives. Budget: ``budgets/serve_cpu8.json``.
+  * ``serve_tp`` — the tensor-parallel serve contract (ISSUE 14): a
+    tp=2 Engine sharded over the ``model`` axis, lowered with its live
+    placements, pinning the bounded model-axis collectives (and zero
+    everywhere else). Budget: ``budgets/serve_tp_cpu8.json``, mesh
+    ``--mesh=1,1,1,2 --devices=8``.
 
 ``frontier_slice_programs`` is the proof fixture: a decode-frontier
 gather (``dynamic_slice`` at a traced offset) over a row-sharded pool.
@@ -31,14 +36,26 @@ import tempfile
 from typing import List, Tuple
 
 DEFAULT_MESH = (1, 2, 2, 2)          # (dp, fsdp, sp, tp) over 8 devices
-FLEETS = ("train", "serve")
+SERVE_TP_MESH = (1, 1, 1, 2)         # serve_tp: pure model-axis mesh
+FLEETS = ("train", "serve", "serve_tp")
 
 
 def build_mesh(mesh_spec: Tuple[int, int, int, int] = DEFAULT_MESH):
+    import jax
+
     from nanosandbox_tpu.parallel.mesh import make_mesh
 
     dp, fsdp, sp, tp = mesh_spec
-    return make_mesh(dp, fsdp, tp, sp)
+    # A mesh smaller than the bootstrapped device fleet takes the first
+    # prod(mesh) devices — the serve_tp fleet states its contract on a
+    # pure (1, 1, 1, tp) mesh (a spectator data axis would collect
+    # partitioner layout noise into the budget) while the process still
+    # runs the standard 8-virtual-device CI bootstrap.
+    devices = list(jax.devices())
+    n = dp * fsdp * sp * tp
+    if len(devices) > n:
+        devices = devices[:n]
+    return make_mesh(dp, fsdp, tp, sp, devices=devices)
 
 
 def train_programs(mesh) -> List:
@@ -146,6 +163,64 @@ def serve_programs(mesh) -> List:
             + scan_specs)
 
 
+def serve_tp_programs(mesh) -> List:
+    """The TENSOR-PARALLEL serve fleet (ISSUE 14) — the rewrite of the
+    zero-collectives serve contract ROADMAP 1 called for: a tp=2
+    Engine sharded over the mesh's ``model`` axis (Megatron weights,
+    heads-sharded paged int8 KV pool, replicated slot state), lowered
+    with its LIVE placements so the partitioner inserts the real
+    collectives. The committed budget (budgets/serve_tp_cpu8.json) pins
+    them: bounded model-axis all-reduces/permutes on decode, every
+    prefill rung x bucket, spec verify and the scan megaprogram rungs —
+    and ZERO collectives anywhere else. gather_ok_axes stays empty, so
+    a dropped with_sharding_constraint that all-gathers the full pool
+    (the frontier_slice accident, on the serving pool) is a CI finding
+    with exact bytes, not a budget line item.
+
+    Run with ``--mesh=1,1,1,2 --devices=8``: the engine shards over a
+    pure model-axis mesh (the first 2 of the 8 bootstrapped CI
+    devices). A spectator data axis would let the partitioner park
+    layout choices on it and leak data-axis noise into the contract —
+    on this mesh every collective is model-axis by construction, and
+    the budget enforces exactly that."""
+    import jax
+    import jax.numpy as jnp
+
+    from nanosandbox_tpu.config import GPTConfig
+    from nanosandbox_tpu.models.gpt import GPT
+    from nanosandbox_tpu.parallel.mesh import axis_sizes
+    from nanosandbox_tpu.serve.drafters import NGramDrafter
+    from nanosandbox_tpu.serve.engine import Engine
+
+    tp = axis_sizes(mesh)["model"]
+    if tp < 2:
+        raise ValueError(
+            f"serve_tp fleet needs a mesh with model >= 2, got "
+            f"{axis_sizes(mesh)} (run with --mesh=1,1,1,2 --devices=8)")
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=64, block_size=64,
+                    vocab_size=256, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # The default TP serve shape: paged + int8 pool, a host drafter so
+    # the spec_verify program is in the pinned set (a device drafter
+    # would need its own sharded pool — engine rejects that for now).
+    engine = Engine(model, params, num_slots=4, max_len=32,
+                    prefill_buckets=(16, 32), kv_dtype="int8",
+                    spec=NGramDrafter(k=3), tp=tp, tp_mesh=mesh)
+    # The scan megaprogram ladder under TP: each rung is its own comms
+    # surface (k model-axis all-reduce rounds fused into one program).
+    # Its prefill/rung-1 programs are identical to the base engine's
+    # and are filtered rather than double-pinned.
+    engine_scan = Engine(model, params, num_slots=4, max_len=32,
+                         prefill_buckets=(16, 32), kv_dtype="int8",
+                         scan_k=4, tp=tp, tp_mesh=mesh)
+    scan_specs = [s for s in engine_scan.shardcheck_programs(mesh)
+                  if "decode_scan" in s.name]
+    return engine.shardcheck_programs(mesh) + scan_specs
+
+
 def frontier_slice_programs(mesh, constrained: bool) -> List:
     """The fixture pair (see module docstring). ``constrained=False``
     drops the with_sharding_constraint — the injected accident."""
@@ -174,7 +249,7 @@ def frontier_slice_programs(mesh, constrained: bool) -> List:
     def frontier_bad(pool, start):
         # The dropped constraint: a traced-offset dynamic_slice on the
         # sharded dim forces GSPMD to all-gather the ENTIRE pool.
-        return dynamic_slice_in_dim(pool, start, 8, axis=0)
+        return dynamic_slice_in_dim(pool, start, 8, axis=0)  # jaxlint: disable=unconstrained-frontier-slice -- the deliberate bad twin the fixture test pins
 
     if constrained:
         name = "frontier_slice"
@@ -202,4 +277,6 @@ def fleet_programs(fleet: str, mesh) -> List:
         return train_programs(mesh)
     if fleet == "serve":
         return serve_programs(mesh)
+    if fleet == "serve_tp":
+        return serve_tp_programs(mesh)
     raise ValueError(f"unknown fleet {fleet!r}; known: {FLEETS}")

@@ -370,3 +370,50 @@ def export_manifest_metrics(manifest_or_budget: Dict[str, Any],
         for kind, count in sorted(by_kind.items()):
             g.labels(program=name, kind=kind).set(count)
         gb.labels(program=name).set(total_bytes)
+
+
+def _bytes_per_token(name: str, static_bytes: float) -> float:
+    """Runtime collective bytes per emitted token for one serve
+    program, from its STATIC manifest/budget bytes and the program-name
+    conventions Engine.shardcheck_programs pins. Two corrections meet
+    here: a decode_scan<r> megaprogram's collectives live in a lax.scan
+    BODY the manifest counts ONCE but the dispatch executes r times
+    while emitting r tokens — the r's cancel, so bytes/token equals the
+    static body bytes (rung-1 decode's wire cost: scan amortizes HOST
+    DISPATCH, not collectives). A prefill_*_k<K>_L* wave's static bytes
+    already scale with the (K, L) operand shapes and the dispatch
+    samples K first tokens, so it normalizes by K. Everything else
+    (decode, spec_verify, drafter programs) is 1 token per dispatch —
+    verify emits a variable 1..k+1, so 1 is the conservative floor."""
+    import re
+
+    if re.search(r"^decode_scan\d+", name):
+        return static_bytes
+    m = re.search(r"_k(\d+)_L\d+", name)
+    if m:
+        return static_bytes / int(m.group(1))
+    return static_bytes
+
+
+def export_collective_bytes_per_token(manifest_or_budget: Dict[str, Any],
+                                      registry) -> None:
+    """Publish ``serve_collective_bytes_per_token{program=}`` gauges
+    from a shardcheck budget/manifest: the pinned collective bytes one
+    dispatch of each serve program moves, normalized by the tokens that
+    dispatch emits — the wire cost of tensor-parallel serving on the
+    same scrape as the throughput it buys. The serve frontend calls
+    this at startup alongside export_manifest_metrics when running
+    under a TP budget."""
+    g = registry.gauge(
+        "serve_collective_bytes_per_token",
+        "Pinned collective bytes per generated token per compiled "
+        "program (shardcheck budget; prefill waves normalize by their "
+        "K sampled tokens, scan rungs by their r-times-executed body).",
+        labelnames=("program",))
+    for name, entry in manifest_or_budget.get("programs", {}).items():
+        table = entry.get("collectives", entry) if isinstance(entry, dict) \
+            else {}
+        total_bytes = sum(
+            int(slot.get("bytes_moved", slot.get("bytes", 0)))
+            for slot in table.values())
+        g.labels(program=name).set(_bytes_per_token(name, total_bytes))

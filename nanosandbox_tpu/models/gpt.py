@@ -91,6 +91,35 @@ class CausalSelfAttention(nn.Module):
             # K/V is the only representation the pool holds. int4 packs
             # two nibbles per byte along head_dim (uint8 storage, the
             # dtype that distinguishes the two modes).
+            # Tensor-parallel serving (mesh with model > 1): heads are
+            # sharded over the ``model`` axis — column-parallel c_attn
+            # lands q/k/v pre-sharded by head, the KV pool (and its
+            # per-position scales) lives row-sharded along its heads
+            # dim, and attention is embarrassingly parallel across
+            # heads. The constraints below are ANCHORS threaded through
+            # every cached path (decode, prefill, scan body, spec
+            # verify): each one is free when the sharding already
+            # matches, and dropping any of them is exactly how GSPMD
+            # quietly rebuilds the whole pool on every chip — the
+            # full-pool all-gather the shardcheck ``frontier_slice``
+            # fixture pins against the bounded exchange. The TP serve
+            # budget (budgets/serve_tp_cpu8.json) CI-fails if that ever
+            # happens.
+            tp_mesh = (self.mesh if self.mesh is not None
+                       and self.mesh.shape.get("model", 1) > 1 else None)
+
+            def _tp(x, *spec):
+                if tp_mesh is None or x is None:
+                    return x
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(tp_mesh, PartitionSpec(*spec)))
+
+            q = _tp(q, None, "model", None, None)
+            k = _tp(k, None, "model", None, None)
+            v = _tp(v, None, "model", None, None)
+
             quantized = len(cache) == 4
             four_bit = quantized and cache[0].dtype == jnp.uint8
             _quantize = quantize_kv_rows_int4 if four_bit \
@@ -209,8 +238,54 @@ class CausalSelfAttention(nn.Module):
                     cvs = lax.dynamic_update_slice(cvs, vs_w,
                                                    (0, 0, cache_index))
                 qpos = (cache_index + jnp.arange(T))[None, :]  # (1, T) global
+            # Re-anchor the UPDATED pool layers: paged (N, H, page, D)
+            # and dense (B, H, L, D) both carry heads at dim 1 (scales
+            # drop the trailing D). Without this the jit's output
+            # sharding is whatever the partitioner inferred — one
+            # inference change away from returning the pool replicated,
+            # i.e. all-gathering it every step.
+            ck = _tp(ck, None, "model", None, None)
+            cv = _tp(cv, None, "model", None, None)
+            if quantized:
+                cks = _tp(cks, None, "model", None)
+                cvs = _tp(cvs, None, "model", None)
             decode_impl = resolve_decode_impl(
                 getattr(cfg, "decode_impl", "auto"))
+
+            sm_scale = 1.0 / head_dim ** 0.5
+            interpret = decode_impl == "pallas_interpret"
+            from jax.sharding import PartitionSpec as _P
+            HP = _P(None, "model", None)         # q (B,H,D) / (.,H,.) scales
+            PL = _P(None, "model", None, None)   # pool layers / (B,H,T,D) q
+
+            def _heads_shard(fn, out_spec, args, in_specs):
+                """Run a flash kernel per-shard over LOCAL heads under
+                tensor parallelism: GSPMD cannot partition Mosaic custom
+                calls, so under a model > 1 mesh the kernel body runs
+                inside shard_map with the heads dim split over ``model``
+                — the grid already iterates (B*H) rows, so each shard
+                simply sees H_local rows and the kernel body is
+                unchanged. Single-chip engines call the kernel direct."""
+                if tp_mesh is None:
+                    return fn(*args)
+                from nanosandbox_tpu.parallel.mesh import shard_map
+
+                return shard_map(fn, mesh=tp_mesh, in_specs=in_specs,
+                                 out_specs=out_spec, check_vma=False)(*args)
+
+            def _kernel(fn_kw, base, base_specs, out_spec):
+                """One flash-kernel dispatch, TP-aware. Quantized pools
+                append the scale planes as positional shard_map operands
+                (a spec cannot describe a None leaf); fp pools call with
+                the kernels' default None scales."""
+                if quantized:
+                    return _heads_shard(
+                        lambda *a: fn_kw(*a[:-2], k_scale=a[-2],
+                                         v_scale=a[-1]),
+                        out_spec, base + (cks, cvs),
+                        base_specs + (HP, HP))
+                return _heads_shard(fn_kw, out_spec, base, base_specs)
+
             if per_row and T == 1 and decode_impl != "xla":
                 # Fused single-query flash decode: one pass over each
                 # row's K/V blocks up to its own frontier, int8 dequant
@@ -219,19 +294,22 @@ class CausalSelfAttention(nn.Module):
                 # routes the block-table variant: the same walk, with
                 # each chunk's address an indirection through the table.
                 if block_table is not None:
-                    y = flash_decode_paged(
-                        q[:, :, 0, :], ck, cv, block_table,
-                        cache_index + 1, k_scale=cks, v_scale=cvs,
-                        sm_scale=1.0 / head_dim ** 0.5,
-                        interpret=(decode_impl == "pallas_interpret"))[
-                            :, :, None, :]
+                    y = _kernel(
+                        lambda *a, **kw: flash_decode_paged(
+                            *a, sm_scale=sm_scale, interpret=interpret,
+                            **kw),
+                        (q[:, :, 0, :], ck, cv, block_table,
+                         cache_index + 1),
+                        (HP, PL, PL, _P(None, None), _P(None)),
+                        HP)[:, :, None, :]
                 else:
-                    y = flash_decode(
-                        q[:, :, 0, :], ck, cv, cache_index + 1,
-                        k_scale=cks, v_scale=cvs,
-                        sm_scale=1.0 / head_dim ** 0.5,
-                        interpret=(decode_impl == "pallas_interpret"))[
-                            :, :, None, :]
+                    y = _kernel(
+                        lambda *a, **kw: flash_decode(
+                            *a, sm_scale=sm_scale, interpret=interpret,
+                            **kw),
+                        (q[:, :, 0, :], ck, cv, cache_index + 1),
+                        (HP, PL, PL, _P(None)),
+                        HP)[:, :, None, :]
             elif per_row and T == 1 and block_table is not None:
                 # XLA fallback's paged DECODE fast path: masked
                 # attention contracted straight against the block-
@@ -253,11 +331,12 @@ class CausalSelfAttention(nn.Module):
                 # fallback below, which copies every row's whole chain
                 # into contiguous rows per wave (the last non-kernel
                 # hot path, and the known paged-vs-dense CPU TTFT gap).
-                y = flash_prefill_paged(
-                    q, ck, cv, block_table, cache_index,
-                    k_scale=cks, v_scale=cvs,
-                    sm_scale=1.0 / head_dim ** 0.5,
-                    interpret=(decode_impl == "pallas_interpret"))
+                y = _kernel(
+                    lambda *a, **kw: flash_prefill_paged(
+                        *a, sm_scale=sm_scale, interpret=interpret, **kw),
+                    (q, ck, cv, block_table, cache_index),
+                    (PL, PL, PL, _P(None, None), _P(None)),
+                    PL)
             else:
                 # Masked-score XLA path. When cache_index is a STATIC int
                 # (prefill / sample.generate's first pass) the attended
@@ -319,6 +398,11 @@ class CausalSelfAttention(nn.Module):
                 else:
                     y = jnp.einsum("bhts,bhsd->bhtd", probs.astype(cv.dtype),
                                    cv_a)
+            # Per-head attention output stays head-sharded into the
+            # row-parallel c_proj below: its (B, T, C) reshape carries
+            # the split on C, so the projection contracts locally and
+            # XLA inserts exactly ONE model-axis all-reduce per block.
+            y = _tp(y, None, "model", None, None)
             new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
         elif cfg.attention_impl == "ring":
             # Sequence-parallel ring attention: T is sharded over the mesh's
@@ -393,6 +477,11 @@ class CausalSelfAttention(nn.Module):
                     dropout_rng=attn_rng,
                     stat_layout=cfg.attention_stat_layout)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+        if cache is not None and tp_mesh is not None:
+            # The merged (H, D) -> C dim keeps the head split: this is
+            # the Megatron row-parallel input layout for c_proj (kernel
+            # sharded on its contraction dim by spec_for_param).
+            y = _tp(y, None, None, "model")
 
         proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
         y = nn.Dense(C, use_bias=cfg.bias, dtype=dtype,

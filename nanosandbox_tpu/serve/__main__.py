@@ -28,6 +28,19 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--num_slots", type=int, default=8,
                     help="concurrent request capacity (decode batch rows)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard ONE engine over "
+                         "the first N devices — Megatron weight "
+                         "placements, the KV pool (and its scale "
+                         "planes) row-sharded along heads over the "
+                         "``model`` mesh axis, slot state replicated. "
+                         "Greedy outputs are token-identical to tp=1; "
+                         "the comms contract is CI-pinned in "
+                         "budgets/serve_tp_cpu8.json and exported on "
+                         "/metrics at startup (serve_tp_degree + "
+                         "serve_collective_bytes_per_token). Requires "
+                         "n_head %% tp == 0 and N local devices; 1 = "
+                         "the single-chip engine, unchanged")
     ap.add_argument("--max_len", type=int, default=0,
                     help="per-slot KV length; 0 = block_size")
     ap.add_argument("--device", default="auto")
@@ -175,7 +188,25 @@ def main(argv: list[str] | None = None) -> None:
     # from the fallback, so the sentinel is None, not the path).
     shardcheck_budget = None
     implicit_budget = args.shardcheck_budget is None
-    budget_path = ("budgets/serve_cpu8.json" if implicit_budget
+    # A tensor-parallel engine runs under the TP comms contract — the
+    # implicit default follows the --tp flag so the exported gauges
+    # describe the engine actually serving. The committed contract is
+    # pinned at tp=2; any OTHER degree gets no implicit budget (its
+    # program names and bytes would describe a different engine —
+    # misleading gauges are worse than none) and must pass an explicit
+    # --shardcheck_budget regenerated at that degree.
+    if args.tp > 1:
+        default_budget = ("budgets/serve_tp_cpu8.json" if args.tp == 2
+                          else None)
+        if default_budget is None and implicit_budget:
+            print(f"[serve] no committed shardcheck budget for tp="
+                  f"{args.tp} (the pinned contract is tp=2) — skipping "
+                  "the /metrics budget export; pass --shardcheck_budget="
+                  "<path> regenerated at this degree to restore it",
+                  file=sys.stderr, flush=True)
+    else:
+        default_budget = "budgets/serve_cpu8.json"
+    budget_path = (default_budget if implicit_budget
                    else args.shardcheck_budget)
     if budget_path:
         import os
@@ -220,7 +251,7 @@ def main(argv: list[str] | None = None) -> None:
     engine = Engine(trainer.model, params, num_slots=args.num_slots,
                     max_len=args.max_len or None,
                     pipeline=not args.no_pipeline, spec=drafter,
-                    scan_k=args.scan_k,
+                    scan_k=args.scan_k, tp=args.tp,
                     kv_dtype=args.kv_dtype, decode_impl=args.decode_impl,
                     paged=args.paged == "on",
                     kv_page_size=args.kv_page_size,
@@ -313,10 +344,16 @@ def main(argv: list[str] | None = None) -> None:
     # dashboard that watches its latency.
     if shardcheck_budget is not None:
         from nanosandbox_tpu.analysis.shardcheck import (
-            export_manifest_metrics)
+            export_collective_bytes_per_token, export_manifest_metrics)
         from nanosandbox_tpu.obs import global_registry
 
         export_manifest_metrics(shardcheck_budget, global_registry())
+        if args.tp > 1:
+            # The TP wire cost per token, per program — the startup
+            # shardcheck pass normalized onto the scrape next to the
+            # serve_tp_degree gauge the engine itself exports.
+            export_collective_bytes_per_token(shardcheck_budget,
+                                              global_registry())
         print(f"[serve] shardcheck budget {budget_path} exported to "
               "/metrics", file=sys.stderr, flush=True)
     if fault_plan is not None:
@@ -340,7 +377,8 @@ def main(argv: list[str] | None = None) -> None:
                  + ("" if args.no_prefix_cache else " + prefix cache")
                  if engine.paged else "dense per-slot rows")
     print(f"[serve] checkpoint step {step}; {args.num_slots} slots x "
-          f"{engine.max_len} ctx ({pool_desc}, kv_dtype={engine.kv_dtype}, "
+          f"{engine.max_len} ctx, tp={engine.tp} "
+          f"({pool_desc}, kv_dtype={engine.kv_dtype}, "
           f"decode_impl={engine.decode_impl}, recovery="
           f"{'off' if supervisor is None else 'on'}, "
           f"prefill_chunk={engine.prefill_chunk or 'off'}, preemption="

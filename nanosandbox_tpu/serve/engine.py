@@ -333,6 +333,30 @@ class Engine:
         scan chunk -> suspend spec -> shed batch class -> interactive
         only) with hysteresis, each transition a flight/metrics event.
         Default False; `python -m nanosandbox_tpu.serve` turns it on.
+    tp : tensor-parallel degree (default 1 = today's single-chip
+        engine, bit-for-bit unchanged). tp > 1 shards ONE engine over
+        a (1, 1, 1, tp) mesh on the first tp devices: weights via the
+        Megatron placements in parallel/sharding.py (column-parallel
+        c_attn/c_fc, row-parallel c_proj), the KV pool — paged block
+        heap or dense slot rows — and its per-position scale planes
+        row-sharded along the HEADS dim over the ``model`` axis, and
+        the per-slot frontier/slot state replicated (it is O(slots)
+        ints; the bytes live in the pool). Decode/prefill/scan/verify
+        all ride with_sharding_constraint anchors (models/gpt.py) so
+        the only collectives are the bounded per-block activation
+        exchanges — one model-axis all-reduce per block plus the qkv
+        head resharding — never a full-pool all-gather; the committed
+        budgets/serve_tp_cpu8.json pins exactly that contract in CI.
+        Greedy outputs are token-identical to tp=1 (same keys, same
+        per-row math; collectives are deterministic — pinned by test),
+        the compile set does not widen, and recovery/preemption
+        rebuild the SHARDED placements. Requires n_head % tp == 0.
+        Flash kernels run per-shard over local heads via shard_map;
+        the gather-free XLA paths partition under the same anchors.
+    tp_mesh : an explicit mesh to shard over instead of the default
+        (1, 1, 1, tp) slice — shardcheck's fleet lowers the tp=2
+        engine under the full cpu8 mesh this way. Its ``model`` axis
+        size must equal ``tp``.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -354,7 +378,8 @@ class Engine:
                  spec_fault_tolerance: int = 3,
                  prefill_chunk: Optional[int] = None,
                  preemption: bool = True,
-                 brownout: bool = False):
+                 brownout: bool = False,
+                 tp: int = 1, tp_mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -372,6 +397,47 @@ class Engine:
                 cfg=model.cfg.replace(decode_impl=decode_impl),
                 mesh=getattr(model, "mesh", None))
         cfg = model.cfg
+        # Tensor-parallel setup (tp > 1): build/validate the mesh, bind
+        # it onto the model (the with_sharding_constraint anchors in
+        # models/gpt.py key off it), and commit the weights to their
+        # Megatron placements. Pool/state placement happens below where
+        # those arrays are built; tp == 1 takes none of these branches.
+        self.tp = int(tp)
+        self._mesh = None
+        self._rep = None
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if self.tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from nanosandbox_tpu.parallel.mesh import (axis_sizes,
+                                                       make_mesh)
+            from nanosandbox_tpu.parallel.sharding import param_shardings
+
+            if cfg.n_head % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide n_head={cfg.n_head}: the "
+                    "KV pool shards along the heads dim")
+            if tp_mesh is not None:
+                mesh = tp_mesh
+                if axis_sizes(mesh).get("model", 1) != self.tp:
+                    raise ValueError(
+                        f"tp_mesh model axis is {axis_sizes(mesh)} but "
+                        f"tp={self.tp}")
+            else:
+                devs = jax.devices()
+                if len(devs) < self.tp:
+                    raise ValueError(
+                        f"tp={self.tp} needs {self.tp} devices, have "
+                        f"{len(devs)}")
+                mesh = make_mesh(1, 1, self.tp, 1,
+                                 devices=devs[:self.tp])
+            self._mesh = mesh
+            self._rep = NamedSharding(mesh, PartitionSpec())
+            model = type(model)(cfg=cfg, mesh=mesh)
+            params = jax.device_put(
+                params,
+                param_shardings(mesh, jax.eval_shape(lambda: params),
+                                shard_params=False, tp=True))
         self.kv_dtype = normalize_kv_dtype(kv_dtype) or (
             "bf16" if cfg.compute_dtype == "bfloat16" else "fp32")
         # Resolve ONCE at construction (the probe caches per backend):
@@ -469,15 +535,17 @@ class Engine:
             self.slot_blocks = -(-self.max_len // kv_page_size)
             self.kv_pool_blocks = int(kv_pool_blocks
                                       or num_slots * self.slot_blocks)
-            self._pool = init_paged_cache(cfg, self.kv_pool_blocks,
-                                          kv_page_size, kv_dtype=kv_dtype)
+            self._pool = self._place_pool(
+                init_paged_cache(cfg, self.kv_pool_blocks, kv_page_size,
+                                 kv_dtype=kv_dtype))
             self.block_pool = BlockPool(self.kv_pool_blocks, kv_page_size,
                                         prefix_cache=prefix_cache)
         else:
             self.slot_blocks = 0
             self.kv_pool_blocks = 0
-            self._pool = init_cache(cfg, num_slots, self.max_len,
-                                    kv_dtype=kv_dtype)
+            self._pool = self._place_pool(
+                init_cache(cfg, num_slots, self.max_len,
+                           kv_dtype=kv_dtype))
         # The kv_dtype ARGUMENT (not the resolved mode): recover() must
         # rebuild the pool with exactly the constructor's layout.
         self._kv_dtype_arg = kv_dtype
@@ -629,6 +697,12 @@ class Engine:
         self._g_kv = m.gauge(
             "serve_kv_dtype", "KV-pool storage mode (1 = active).",
             labelnames=("kv_dtype",))
+        # Tensor-parallel posture (ISSUE 14): the model-axis shard
+        # count this engine decodes across (1 = single chip).
+        self._g_tp = m.gauge(
+            "serve_tp_degree",
+            "Tensor-parallel degree of the decode engine (model-axis "
+            "shards; 1 = single chip).")
         # Paged-pool + prefix-cache signal (ISSUE 9): block states
         # partition the pool, the hit/miss token counters are the
         # prefix_hit_rate numerator/denominator, and TTFT re-observes
@@ -731,6 +805,17 @@ class Engine:
         if spec is not None:
             from nanosandbox_tpu.serve.spec import SpecRunner
 
+            if self.tp > 1 and getattr(spec, "kind", "host") == "device":
+                # A device drafter owns its OWN model + KV pool; running
+                # it under TP means sharding that second model too —
+                # future work. Host drafters (NGram prompt lookup) ride
+                # TP today: the verify program is the target model's and
+                # shards like every other cached path.
+                raise ValueError(
+                    "tp > 1 supports host drafters only (e.g. "
+                    "NGramDrafter); a tensor-parallel ModelDrafter "
+                    "needs its own sharded pool")
+
             self._spec = SpecRunner(
                 spec, model=model, num_slots=num_slots,
                 max_len=self.max_len,
@@ -808,10 +893,49 @@ class Engine:
     def _meta_width(self) -> int:
         return (self.slot_blocks + 5) if self.paged else 4
 
+    def _place_pool(self, pool: list) -> list:
+        """Commit a freshly-built KV pool to its tensor-parallel
+        placement — values AND scale planes row-sharded along the heads
+        dim over the ``model`` axis (paged (N, H, page, D) and dense
+        (S, H, L, D) both carry heads at dim 1). Identity at tp == 1.
+        Construction and the recovery rebuild both come through here,
+        so a recovered engine's placements match a fresh one's."""
+        if self._mesh is None:
+            return pool
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        val = NamedSharding(self._mesh, P(None, "model", None, None))
+        sc = NamedSharding(self._mesh, P(None, "model", None))
+        out = []
+        for layer in pool:
+            placed = (jax.device_put(layer[0], val),
+                      jax.device_put(layer[1], val))
+            if len(layer) == 4:
+                placed += (jax.device_put(layer[2], sc),
+                           jax.device_put(layer[3], sc))
+            out.append(placed)
+        return out
+
+    def _stage(self, x):
+        """Host->device staging for wave operands. Under TP the upload
+        is an explicit replicated device_put (one copy per mesh device
+        — these are O(wave) int32 rows, not pool bytes); tp == 1 keeps
+        the plain single-device transfer."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, self._rep)
+
     def _fresh_slot_state(self) -> dict:
         """A fully-parked device slot-state dict — construction AND the
         recovery rebuild use the same one, so a recovered engine starts
-        from exactly the state a fresh one would."""
+        from exactly the state a fresh one would. Under TP the struct
+        is REPLICATED over the mesh (O(slots) ints — the sharded bytes
+        are the pool's, and a replicated frontier is what lets every
+        shard mask its local heads without an exchange)."""
         import jax.numpy as jnp
 
         state = {
@@ -827,6 +951,10 @@ class Engine:
             state["table"] = jnp.full(
                 (self.num_slots, self.slot_blocks), self.kv_pool_blocks,
                 jnp.int32)
+        if self._mesh is not None:
+            import jax
+
+            state = jax.device_put(state, self._rep)
         return state
 
     def _split_meta(self, meta, fmeta):
@@ -1049,6 +1177,7 @@ class Engine:
         self._g_rate.set(0.0 if rate is None else rate)
         self._g_impl.labels(impl=self.decode_impl).set(1.0)
         self._g_kv.labels(kv_dtype=self.kv_dtype).set(1.0)
+        self._g_tp.set(float(self.tp))
         if self.block_pool is not None:
             ps = self.block_pool.stats()
             for state in ("free", "live", "cached"):
@@ -1534,6 +1663,7 @@ class Engine:
             "num_slots": self.num_slots,
             "max_len": self.max_len,
             "kv_dtype": self.kv_dtype,
+            "tp": self.tp,
             "paged": self.paged,
             "kv_page_size": self.kv_page_size,
             "kv_pool_blocks": self.kv_pool_blocks,
@@ -1626,16 +1756,30 @@ class Engine:
             progs.update(self._spec.programs)
         return progs
 
+    @property
+    def mesh(self):
+        """The tensor-parallel mesh this engine shards over (None at
+        tp == 1 — the single-chip engine owns no mesh)."""
+        return self._mesh
+
     def shardcheck_programs(self, mesh) -> list:
         """ProgramSpecs for the comms analyzer (analysis/shardcheck):
         the engine's full compiled set — decode, the prefill
         ladder x bucket grid, and (with spec=...) the verify/drafter
-        programs — AOT-lowered under ``mesh`` with every operand
-        REPLICATED. That is today's single-chip contract stated on the
-        mesh: the partitioner runs for real, so the committed budgets
-        pin ZERO collectives, and ROADMAP item 1's tensor-parallel
-        serving must rewrite them explicitly. Fresh jits: an analysis
-        lower must not consume the live tracecheck budgets."""
+        programs — AOT-lowered under ``mesh``.
+
+        tp == 1 lowers with every operand REPLICATED: the single-chip
+        contract stated on the mesh, so the committed serve budget pins
+        ZERO collectives. tp > 1 lowers under the engine's OWN mesh
+        with the LIVE placements (Megatron weights, heads-sharded pool,
+        replicated slot state): the partitioner runs for real and the
+        committed TP budget (budgets/serve_tp_cpu8.json) pins the
+        bounded model-axis collectives — while the accidental-all-gather
+        rule stays armed (gather_ok_axes empty), so a dropped
+        with_sharding_constraint that rebuilds the full pool on every
+        chip is a CI finding with exact bytes, not a silent 2x HBM
+        regression. Fresh jits: an analysis lower must not consume the
+        live tracecheck budgets."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
@@ -1645,13 +1789,36 @@ class Engine:
         from nanosandbox_tpu.parallel.mesh import replicated_abstract
 
         rep = NamedSharding(mesh, PartitionSpec())
-        aparams = replicated_abstract(mesh, self.params)
-        apool = replicated_abstract(mesh, self._pool)
-        astate = replicated_abstract(mesh, self._state)
-        expect = Expectations(comms_free=True)
+        if self.tp > 1:
+            if mesh is not self._mesh:
+                raise ValueError(
+                    "a tensor-parallel engine lowers under its own mesh "
+                    "— pass engine.mesh (or build the engine with "
+                    "tp_mesh=<the fleet mesh>)")
 
-        def jit_rep(fn):
-            return jax.jit(fn, in_shardings=rep, out_shardings=rep)
+            def live_abstract(tree):
+                return jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=x.sharding),
+                    tree)
+
+            aparams = live_abstract(self.params)
+            apool = live_abstract(self._pool)
+            astate = live_abstract(self._state)
+            # Comms expected — the budget pins how much and where; the
+            # empty gather_ok_axes keeps accidental-all-gather armed
+            # against any full materialization of the sharded pool.
+            expect = Expectations(comms_free=False)
+            jit_kwargs = {}
+        else:
+            aparams = replicated_abstract(mesh, self.params)
+            apool = replicated_abstract(mesh, self._pool)
+            astate = replicated_abstract(mesh, self._state)
+            expect = Expectations(comms_free=True)
+            jit_kwargs = {"in_shardings": rep, "out_shardings": rep}
+
+        def jit_fleet(fn):
+            return jax.jit(fn, **jit_kwargs)
 
         def sds(shape, dtype):
             return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
@@ -1665,17 +1832,20 @@ class Engine:
         # a materially different compile surface per rung, so each rung
         # above 1 owns a decode_scan<r> name the budget must list
         # explicitly (rung 1 is the classic single-step program).
+        # Tensor-parallel engines append _tp<N>: a different comms
+        # contract is a different program identity.
         sfx = {"int8": "_kv8", "int4": "_kv4"}.get(self.kv_dtype, "")
         if not self.paged:
             sfx += "_dense"
+        if self.tp > 1:
+            sfx += f"_tp{self.tp}"
 
         def decode_spec(r):
             name = f"decode_scan{r}{sfx}" if r > 1 else f"decode{sfx}"
 
             def lower(r=r):
-                return jax.jit(self._decode_fn, in_shardings=rep,
-                               out_shardings=rep,
-                               static_argnums=(3,)).lower(
+                return jax.jit(self._decode_fn, static_argnums=(3,),
+                               **jit_kwargs).lower(
                                    aparams, apool, astate, r)
 
             return ProgramSpec(name=name, lower=lower,
@@ -1693,13 +1863,14 @@ class Engine:
                 specs.append(ProgramSpec(
                     name=f"prefill{sfx}_k{k}_L{bucket}",
                     lower=(lambda args=args:
-                           jit_rep(prefill_body).lower(*args)),
+                           jit_fleet(prefill_body).lower(*args)),
                     abstract_args=args, expect=expect, tags=("serve",)))
         if self._spec is not None:
             specs.extend(self._spec.shardcheck_programs(
                 mesh, aparams=aparams, apool=apool, astate=astate,
                 buckets=self.sched.buckets, rungs=self.admit_buckets,
-                suffix=sfx))
+                suffix=sfx, expect=expect,
+                replicated_io=self.tp == 1))
         return specs
 
     @property
@@ -1975,9 +2146,9 @@ class Engine:
                     meta[i, nb + 4] = hit
                 else:
                     prompts[i, :len(req.prompt)] = req.prompt
-            prompts_dev = jnp.asarray(prompts)
-            meta_dev = jnp.asarray(meta)
-            fmeta_dev = jnp.asarray(fmeta)
+            prompts_dev = self._stage(prompts)
+            meta_dev = self._stage(meta)
+            fmeta_dev = self._stage(fmeta)
             if (self.faults is not None
                     and self.faults.fire("prefill_exc", self.steps)
                     is not None):
@@ -2159,9 +2330,9 @@ class Engine:
                 meta[0, nb + 4] = start
                 fmeta = np.zeros((1, 2), np.float32)
                 fmeta[0] = (req.temperature, req.top_p)
-                prompts_dev = jnp.asarray(prompts)
-                meta_dev = jnp.asarray(meta)
-                fmeta_dev = jnp.asarray(fmeta)
+                prompts_dev = self._stage(prompts)
+                meta_dev = self._stage(meta)
+                fmeta_dev = self._stage(fmeta)
                 if (self.faults is not None
                         and self.faults.fire("prefill_exc", self.steps)
                         is not None):
@@ -2436,9 +2607,15 @@ class Engine:
                     f"faults (last: {type(e).__name__}: {e})")
         else:
             self._drafter_fault_streak = 0
+        # Under TP the draft block replicates over the mesh explicitly;
+        # tp == 1 keeps the bare-numpy dispatch (measurably cheaper on
+        # the CPU floor, PR 4). dl/drafts stay host-resident numpy for
+        # the per-slot accounting below either way.
+        drafts_in = drafts if self._mesh is None else self._stage(drafts)
+        dl_in = dl if self._mesh is None else self._stage(dl)
         self._pool, self._state, emitted, counts, accepted = \
             runner.verify(self.params, self._pool, self._state,
-                          drafts, dl)
+                          drafts_in, dl_in)
         self.steps += 1
         self.host_dispatches["verify"] += 1
         runner.steps += 1
@@ -2960,14 +3137,18 @@ class Engine:
                                                     init_paged_cache)
             if self.paged:
                 self.block_pool.reset_cache()
-                self._pool = init_paged_cache(self.cfg,
-                                              self.kv_pool_blocks,
-                                              self.kv_page_size,
-                                              kv_dtype=self._kv_dtype_arg)
+                # _place_pool: a TP engine's rebuilt pool must land on
+                # the SAME heads-sharded placement the anchors expect —
+                # a replicated rebuild would reshard (or worse, gather)
+                # on the first post-recovery dispatch.
+                self._pool = self._place_pool(
+                    init_paged_cache(self.cfg, self.kv_pool_blocks,
+                                     self.kv_page_size,
+                                     kv_dtype=self._kv_dtype_arg))
             else:
-                self._pool = init_cache(self.cfg, self.num_slots,
-                                        self.max_len,
-                                        kv_dtype=self._kv_dtype_arg)
+                self._pool = self._place_pool(
+                    init_cache(self.cfg, self.num_slots, self.max_len,
+                               kv_dtype=self._kv_dtype_arg))
         self._state = self._fresh_slot_state()
         # FIFO restoration: victims re-enter at the head of their
         # PRIORITY CLASS in rid (= original admission) order, ahead of

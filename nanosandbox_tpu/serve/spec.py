@@ -264,11 +264,17 @@ class SpecRunner:
 
     # ------------------------------------------------------------------
     def shardcheck_programs(self, mesh, *, aparams, apool, astate,
-                            buckets=(), rungs=(), suffix: str = "") -> list:
+                            buckets=(), rungs=(), suffix: str = "",
+                            expect=None, replicated_io: bool = True,
+                            ) -> list:
         """ProgramSpecs for the verify program (and, for a device
         drafter, its draft/draft_prefill programs) — the speculative
-        half of Engine.shardcheck_programs, same replicated-on-the-mesh
-        contract and the same comms-free expectation."""
+        half of Engine.shardcheck_programs. The engine passes the
+        abstract pool/state with its OWN placements plus the matching
+        expectation: replicated + comms-free for the single-chip
+        contract, live TP shardings + budget-pinned comms for a
+        tensor-parallel engine (``replicated_io=False`` drops the
+        all-replicated jit constraints so the declared shardings win)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
@@ -276,7 +282,11 @@ class SpecRunner:
         from nanosandbox_tpu.analysis.shardcheck import (Expectations,
                                                          ProgramSpec)
 
+        if expect is None:
+            expect = Expectations(comms_free=True)
         rep = NamedSharding(mesh, PartitionSpec())
+        jit_kwargs = ({"in_shardings": rep, "out_shardings": rep}
+                      if replicated_io else {})
         drafts = jax.ShapeDtypeStruct((self.num_slots, self.k), jnp.int32,
                                       sharding=rep)
         dlen = jax.ShapeDtypeStruct((self.num_slots,), jnp.int32,
@@ -284,10 +294,10 @@ class SpecRunner:
         args = (aparams, apool, astate, drafts, dlen)
         specs = [ProgramSpec(
             name=f"spec_verify{suffix}",
-            lower=lambda: jax.jit(self._verify_fn, in_shardings=rep,
-                                  out_shardings=rep).lower(*args),
+            lower=lambda: jax.jit(self._verify_fn,
+                                  **jit_kwargs).lower(*args),
             abstract_args=args,
-            expect=Expectations(comms_free=True), tags=("serve", "spec"))]
+            expect=expect, tags=("serve", "spec"))]
         if self.drafter.kind == "device":
             specs.extend(self.drafter.shardcheck_programs(
                 mesh, buckets=buckets, rungs=rungs, suffix=suffix))

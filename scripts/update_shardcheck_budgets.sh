@@ -18,6 +18,12 @@ python -m nanosandbox_tpu.analysis shardcheck --fleet=train \
     --write-budget=budgets/train_cpu8.json
 python -m nanosandbox_tpu.analysis shardcheck --fleet=serve \
     --write-budget=budgets/serve_cpu8.json
+# The tensor-parallel serve contract states itself on a PURE model-axis
+# mesh (a spectator data axis would leak partitioner layout noise into
+# the pinned counts) while keeping the standard 8-device CI bootstrap.
+python -m nanosandbox_tpu.analysis shardcheck --fleet=serve_tp \
+    --mesh=1,1,1,2 --devices=8 \
+    --write-budget=budgets/serve_tp_cpu8.json
 
-echo "regenerated budgets/train_cpu8.json + budgets/serve_cpu8.json —"
+echo "regenerated budgets/{train,serve,serve_tp}_cpu8.json —"
 echo "review the diff and commit it WITH the change that moved the needle"

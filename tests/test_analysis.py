@@ -241,6 +241,56 @@ def place(params, sharding):
     return a, b
 """,
     ),
+    "unconstrained-frontier-slice": (
+        # A traced-offset slice of a pool in a mesh-aware module with
+        # no constraint in sight — if the pool is sharded along dim 0,
+        # GSPMD all-gathers ALL of it on every device (the shardcheck
+        # frontier_slice fixture's accident, KV-pool edition). The
+        # keyword-spelled offset must be caught too, and a
+        # discarded-result constraint launders nothing (the functional
+        # result is what carries the sharding).
+        """
+from jax.lax import dynamic_slice_in_dim, with_sharding_constraint
+from jax.sharding import NamedSharding
+
+
+def frontier(pool, start):
+    return dynamic_slice_in_dim(pool, start, 8, axis=0)
+
+
+def frontier_kw(pool, start):
+    return dynamic_slice_in_dim(pool, start_index=start, slice_size=8,
+                                axis=0)
+
+
+def frontier_discarded(pool, start, sh):
+    with_sharding_constraint(pool, sh)
+    return dynamic_slice_in_dim(pool, start, 8, axis=0)
+""",
+        # The idiom: reshard OFF the sliced dim first — in place or as
+        # a rebind to a NEW name; static-offset windows are fine (GSPMD
+        # partitions fixed slices without materializing anything).
+        """
+from jax.lax import dynamic_slice_in_dim, with_sharding_constraint
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def frontier(pool, start, mesh):
+    pool = with_sharding_constraint(
+        pool, NamedSharding(mesh, P(None, "fsdp")))
+    return dynamic_slice_in_dim(pool, start, 8, axis=0)
+
+
+def frontier_rebound(pool, start, mesh):
+    pool_c = with_sharding_constraint(
+        pool, NamedSharding(mesh, P(None, "fsdp")))
+    return dynamic_slice_in_dim(pool_c, start, 8, axis=0)
+
+
+def static_window(pool):
+    return dynamic_slice_in_dim(pool, 0, 8, axis=0)
+""",
+    ),
     "axis-mismatch": (
         # 'sequence' is not a registered mesh axis (it's 'seq').
         """
