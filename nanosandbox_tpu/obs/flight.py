@@ -66,11 +66,20 @@ class FlightRecorder:
     HTTP debug handler never races the engine thread's appends.
     """
 
-    def __init__(self, capacity: int = 65536, enabled: bool = True):
+    def __init__(self, capacity: int = 65536, enabled: bool = True,
+                 namespace: Optional[str] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.enabled = enabled
         self.capacity = capacity
+        # Fleet namespacing (ISSUE 15): with a namespace (the replica
+        # id), every recorded rid becomes "<namespace>:<rid>", so N
+        # replicas' ledgers merge into ONE JSONL trace that stays
+        # exactly-once analyzable — replica 0's request 7 and replica
+        # 1's request 7 are different tracks, not a double terminal.
+        # Engine-internal int-rid lookups keep working: queries
+        # normalize through the same mapping.
+        self.namespace = namespace
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         # Epoch pair: events carry monotonic "t" (orderable, immune to
@@ -81,6 +90,13 @@ class FlightRecorder:
         self.recorded = 0            # total ever (ring rotation visible)
         self._cleared = 0            # events removed by clear(), not rotation
 
+    def _rid(self, rid):
+        """Apply the namespace to an engine-local int rid; strings (an
+        already-namespaced id, or a caller's own scheme) pass through."""
+        if rid is None or self.namespace is None or isinstance(rid, str):
+            return rid
+        return f"{self.namespace}:{rid}"
+
     # ------------------------------------------------------------ record
     def record(self, ev: str, rid: Optional[int] = None,
                step: Optional[int] = None, **fields) -> None:
@@ -88,7 +104,7 @@ class FlightRecorder:
         request id (a reject happens before one is assigned)."""
         if not self.enabled:
             return
-        e: dict = {"t": time.monotonic(), "ev": ev, "rid": rid}
+        e: dict = {"t": time.monotonic(), "ev": ev, "rid": self._rid(rid)}
         if step is not None:
             e["step"] = step
         if fields:
@@ -109,6 +125,7 @@ class FlightRecorder:
         timestamp. Optionally filtered to one rid / trailing window."""
         out = self._snapshot()
         if rid is not None:
+            rid = self._rid(rid)
             out = [e for e in out if e.get("rid") == rid]
         if last_s is not None:
             horizon = time.monotonic() - last_s
@@ -135,6 +152,7 @@ class FlightRecorder:
     def terminals(self, rid: int) -> List[str]:
         """Terminal event names recorded for one rid — the no-orphan
         test asserts len == 1 for every request the engine ever saw."""
+        rid = self._rid(rid)
         return [e["ev"] for e in self._snapshot()
                 if e.get("rid") == rid and e["ev"] in TERMINAL_EVENTS]
 
@@ -158,6 +176,7 @@ class FlightRecorder:
             # events (warmup hygiene) are not capacity pressure.
             dropped = self.recorded - self._cleared - len(self._ring)
             return {"enabled": self.enabled, "capacity": self.capacity,
+                    "namespace": self.namespace,
                     "events": len(self._ring), "recorded": self.recorded,
                     "dropped": max(0, dropped)}
 

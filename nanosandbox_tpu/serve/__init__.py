@@ -36,6 +36,15 @@ Pieces:
   brownout.py  — BrownoutController: SLO-ledger-driven graceful load
                  degradation (shrink scan -> suspend spec -> shed
                  batch -> interactive only) with hysteresis.
+  router.py    — PrefixAffinityRouter: fleet-level routing over N
+                 replicas by radix-prefix affinity (approximate
+                 per-replica digest index, load/brownout/readiness
+                 scoring, failover fallback).
+  fleet.py     — Fleet: in-process N-replica harness behind the router
+                 (namespaced flight ledgers, replica_down failover with
+                 exactly-once terminals, aggregated retry hints) — the
+                 test bench for the policy the HTTP front tier and the
+                 k8s router Deployment run.
   __main__.py  — `python -m nanosandbox_tpu.serve` entrypoint: restore a
                  checkpoint and serve it.
 """
@@ -50,9 +59,14 @@ from nanosandbox_tpu.serve.engine import (DEFAULT_PRIORITY,
                                           Result)
 from nanosandbox_tpu.serve.faults import (CANNED, FaultInjected, FaultPlan,
                                           FaultSpec)
+from nanosandbox_tpu.serve.fleet import Fleet
 from nanosandbox_tpu.serve.paged import (Allocation, BlockPool,
-                                         RadixPrefixCache, blocks_for)
+                                         RadixPrefixCache, blocks_for,
+                                         prefix_digests)
 from nanosandbox_tpu.serve.recovery import EngineSupervisor
+from nanosandbox_tpu.serve.router import (NoReadyReplicaError,
+                                          PrefixAffinityRouter,
+                                          RouteDecision)
 from nanosandbox_tpu.serve.scheduler import (SlotScheduler, admit_ladder,
                                              default_buckets)
 
@@ -60,7 +74,9 @@ __all__ = ["Engine", "Request", "Result", "SlotScheduler",
            "admit_ladder", "default_buckets", "NGramDrafter",
            "ModelDrafter", "drafter_from_flag", "BlockPool",
            "RadixPrefixCache", "Allocation", "blocks_for",
-           "FaultPlan", "FaultSpec", "FaultInjected", "CANNED",
-           "EngineSupervisor", "EngineFailedError",
+           "prefix_digests", "FaultPlan", "FaultSpec", "FaultInjected",
+           "CANNED", "EngineSupervisor", "EngineFailedError",
            "BrownoutController", "BROWNOUT_LEVELS",
-           "PRIORITY_BY_CLASS", "DEFAULT_PRIORITY"]
+           "PRIORITY_BY_CLASS", "DEFAULT_PRIORITY",
+           "Fleet", "PrefixAffinityRouter", "RouteDecision",
+           "NoReadyReplicaError"]

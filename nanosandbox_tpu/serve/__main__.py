@@ -21,6 +21,36 @@ import sys
 
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(prog="python -m nanosandbox_tpu.serve")
+    ap.add_argument("--router", action="store_true",
+                    help="run the FLEET ROUTER front tier instead of an "
+                         "engine replica (ISSUE 15): an asyncio proxy "
+                         "routing POST /generate across --replicas by "
+                         "radix-prefix affinity with health/load "
+                         "fallback and failover re-routing. Loads no "
+                         "checkpoint and touches no accelerator — the "
+                         "k8s router Deployment runs exactly this")
+    ap.add_argument("--replicas", default="",
+                    help="router mode: comma-separated replica base "
+                         "URLs (http://host:port), or a "
+                         "dns+http://name:port spec resolved every "
+                         "health interval — point it at the headless "
+                         "Service (serve-replicas.disttrain) and the "
+                         "rotation tracks pod scale-up/down and "
+                         "readiness automatically")
+    ap.add_argument("--health_interval_s", type=float, default=2.0,
+                    help="router mode: seconds between per-replica "
+                         "health + load + prefix-summary polls; a "
+                         "draining/dead replica leaves rotation within "
+                         "one interval")
+    ap.add_argument("--router_page", type=int, default=16,
+                    help="router mode: KV page size the replicas run "
+                         "(must match their --kv_page_size, or prefix "
+                         "fingerprints will never match)")
+    ap.add_argument("--no_affinity", action="store_true",
+                    help="router mode: disable prefix-affinity scoring "
+                         "(pure least-loaded routing — the comparison "
+                         "baseline, and the right mode for dense or "
+                         "cache-less replicas)")
     ap.add_argument("--out_dir", default="out")
     ap.add_argument("--data_dir", default="data")
     ap.add_argument("--dataset", default="shakespeare_char")
@@ -170,6 +200,37 @@ def main(argv: list[str] | None = None) -> None:
                          "compiles one single-request prefill per bucket "
                          "and leaves larger waves to compile lazily")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    if args.router:
+        # Front-tier mode: no checkpoint, no jax — just the router
+        # proxy over the replica fleet.
+        from nanosandbox_tpu.serve.http import RouterFrontend
+
+        replicas = [u for u in args.replicas.split(",") if u.strip()]
+        if not replicas:
+            raise SystemExit("--router needs --replicas=<url,url,...> "
+                             "or --replicas=dns+http://name:port")
+        fe = RouterFrontend(
+            replicas, host=args.host, port=args.port,
+            page=args.router_page,
+            health_interval_s=args.health_interval_s,
+            affinity=not args.no_affinity).start()
+        print(f"[serve-router] routing {replicas} "
+              f"(affinity={'off' if args.no_affinity else 'on'}, "
+              f"page={args.router_page}, health every "
+              f"{args.health_interval_s}s); listening on "
+              f"{args.host}:{fe.port} (POST /generate, GET /healthz "
+              "/debug/router /metrics)", file=sys.stderr, flush=True)
+        try:
+            while True:
+                import time
+
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            fe.stop()
+        return
 
     from nanosandbox_tpu.data.loader import BinDataset
     from nanosandbox_tpu.data.tokenizer import get_tokenizer

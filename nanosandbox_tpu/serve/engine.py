@@ -137,6 +137,13 @@ class Result:
     prompt: tuple
     tokens: List[int]          # generated ids (includes the eos hit, if any)
     finish_reason: str         # 'length' | 'eos' | 'shed' | 'failed'
+    # Chained fingerprints of the prompt's full KV blocks DONATED to
+    # this engine's radix cache at finish (paged.prefix_digests; empty
+    # when the prefix cache is off or nothing was donated). The fleet
+    # router's per-replica index ingests these — "replica r now holds
+    # this chain" — which is what turns the radix cache into a
+    # fleet-wide routing signal (ISSUE 15).
+    prefix_digest: tuple = ()
 
 
 @dataclass
@@ -1230,6 +1237,21 @@ class Engine:
         if not prompt:
             self._reject("empty_prompt",
                          "empty prompt (encode at least one token)")
+        bad = next((t for t in prompt
+                    if not 0 <= t < self.cfg.vocab_size), None)
+        if bad is not None:
+            # An out-of-range id is not just garbage-in-garbage-out:
+            # the embedding gather FILLS out-of-bounds rows (NaN under
+            # jit), the poison sentinel fires on the non-finite logits,
+            # and the recovery supervisor burns every attempt re-
+            # admitting the same request until the engine PERMANENTLY
+            # fails — one malformed request kills the replica (and a
+            # failover-happy fleet would hand the same poison pill to
+            # the next replica). Client errors reject at the boundary.
+            self._reject(
+                "token_out_of_range",
+                f"prompt token {bad} outside [0, vocab_size="
+                f"{self.cfg.vocab_size})", prompt_len=plen)
         if max_new_tokens < 0:
             self._reject(
                 "bad_max_new",
@@ -1683,6 +1705,11 @@ class Engine:
             "active": len(self._active),
             "queued": self.sched.queued,
             "free_slots": self.sched.free_slots,
+            # The classless client-backoff estimate, scrapeable: the
+            # fleet router's HTTP tier aggregates these across replicas
+            # (min over ready) instead of forwarding whichever replica
+            # happened to shed.
+            "retry_after_s": self.retry_after_s(),
             "admitted": self.admitted,
             "completed": self.completed,
             "shed": self.shed,
@@ -1938,6 +1965,22 @@ class Engine:
                 if st.alloc is not None]
         return {"paged": True, "kv_page_size": self.kv_page_size,
                 **self.block_pool.debug(live)}
+
+    def prefix_summary(self) -> dict:
+        """The authoritative radix-cache residency summary a fleet
+        router refreshes its approximate per-replica index from
+        (GET /debug/prefix_summary): one chained fingerprint per
+        resident trie node (paged.prefix_digests' chain, so membership
+        answers "would block i of this prompt hit here"). Pure host
+        bookkeeping over block ids — no device read, no sync. Routers
+        should treat the digest SET as a full replacement: anything
+        absent was LRU-evicted since the last refresh."""
+        if self.block_pool is None or self.block_pool.cache is None:
+            return {"enabled": False, "page": 0, "blocks": 0,
+                    "digests": []}
+        digests = self.block_pool.cache.digests()
+        return {"enabled": True, "page": self.kv_page_size,
+                "blocks": len(digests), "digests": digests}
 
     def debug_scheduler(self) -> dict:
         """Queue composition head-first — per-request wait, deadline
@@ -2914,6 +2957,7 @@ class Engine:
         self._state = self._release(self._state,
                                     jnp.asarray(state.slot, jnp.int32))
         self.host_dispatches["release"] += 1
+        prefix_digest: tuple = ()
         if state.alloc is not None:
             # Host block release: deref the hit chain, DONATE the full
             # prompt blocks to the radix cache, free the rest. Safe even
@@ -2923,6 +2967,15 @@ class Engine:
             # (prompt-only) block — and any reallocation's prefill
             # queues behind it, overwriting its garbage block-for-block.
             self.block_pool.release(state.alloc)
+            if self.block_pool.cache is not None:
+                # What this replica now caches, as chained block
+                # fingerprints (paged.prefix_digests — host-side hashing
+                # of the already-host-resident prompt tuple, no sync):
+                # the fleet router's affinity signal, reported on the
+                # Result, the flight terminal, and the /generate body.
+                from nanosandbox_tpu.serve.paged import prefix_digests
+                prefix_digest = tuple(
+                    prefix_digests(req.prompt, self.kv_page_size))
         self.completed += 1
         self._c_completed.labels(reason=reason).inc()
         # Stitch a recovered request back together: the Result (and its
@@ -2949,6 +3002,8 @@ class Engine:
             fin["resumed"] = True
         if met is not None:
             fin["deadline_met"] = met
+        if prefix_digest:
+            fin["prefix_digest"] = list(prefix_digest)
         self.flight.record("finish", rid=req.rid, step=self.steps, **fin)
         if self._spec is not None:
             self._spec_req_accepted.observe(state.spec_accepted)
@@ -2956,7 +3011,7 @@ class Engine:
             self._tpot.observe((now - state.first_token_t)
                                / (len(state.tokens) - 1))
         return Result(rid=req.rid, prompt=prompt_out, tokens=tokens_out,
-                      finish_reason=reason)
+                      finish_reason=reason, prefix_digest=prefix_digest)
 
     # ------------------------------------------------------------------
     # fault detection, quarantine & crash-safe recovery (ISSUE 11).
@@ -3267,11 +3322,5 @@ class Engine:
         # higher backlog counts double — its depth is the best available
         # proxy for the arrival pressure that will keep jumping this
         # class after it requeues (and, with deadlines, preempting it).
-        ahead = jumps = 0
-        for item in self.sched.queued_items():
-            p = getattr(item, "priority", DEFAULT_PRIORITY)
-            if p >= priority:
-                ahead += 1
-            if p > priority:
-                jumps += 1
+        ahead, jumps = self.sched.queue_mass(priority)
         return base * (1.0 + (ahead + jumps) / max(1, self.num_slots))

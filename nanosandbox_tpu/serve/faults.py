@@ -42,6 +42,14 @@ Sites (where the engine consults the plan — see Engine for the hooks):
                   tokens so far) composes with recovery and still
                   yields token-identical outputs and exactly-once
                   terminals.
+  replica_down    a FLEET-level site (ISSUE 15, consulted by
+                  serve/fleet.py's step, never by an Engine): one live
+                  replica is hard-killed (abort_all — permanent
+                  failure, in-flight requests terminal 'failed') so the
+                  router's failure path is exercised end to end:
+                  health-out within one interval, victims re-routed to
+                  surviving replicas with exactly-once fleet terminals
+                  and token-identical greedy resumes.
 
 Plans are enabled only by the explicit ``Engine(faults=...)`` /
 ``bench.py --faults=...`` hook: with no plan attached every site check
@@ -57,7 +65,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 SITES = ("nan_logits", "slow_step", "alloc_fail", "drafter_fault",
-         "scatter_corrupt", "prefill_exc", "preempt_storm")
+         "scatter_corrupt", "prefill_exc", "preempt_storm",
+         "replica_down")
 
 # Named plans for CI smoke jobs and drills: steps are RELATIVE to the
 # last (re)arm, so `plan.rearm(engine.steps)` after warmup aims the

@@ -33,16 +33,28 @@ No external web framework: the repo's dependency budget is "what the
 image already ships", and http.server is plenty for a JSON
 POST /generate + GET /healthz surface. Anything fancier (streaming,
 cancellation) belongs behind the same EngineLoop seam.
+
+This module also hosts the FLEET FRONT TIER (ISSUE 15):
+``RouterFrontend``, an asyncio proxy that routes POST /generate across
+N replica servers by radix-prefix affinity (serve/router.py — the same
+policy class the in-process Fleet harness tests), with health-poll
+readiness, failover re-routing, and Retry-After hints aggregated over
+the ready replica set. See its docstring and docs/playbook.md "Fleet
+routing".
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import math
+import socket
 import threading
+import urllib.error
 import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional
 
 from nanosandbox_tpu.obs import (MetricRegistry, global_registry,
                                  render_prometheus)
@@ -438,6 +450,11 @@ def make_server(host: str, port: int, loop: EngineLoop,
                 else:
                     self._json(200, {"events": flight.events(
                         rid=rid, last_s=last_s)})
+            elif url.path == "/debug/prefix_summary":
+                # The fleet router's authoritative index refresh
+                # (ISSUE 15): chained fingerprints of every resident
+                # radix-cache chain prefix. Host bookkeeping only.
+                self._json(200, loop.engine.prefix_summary())
             elif url.path == "/debug/slots":
                 self._json(200, loop.engine.debug_slots())
             elif url.path == "/debug/kvpool":
@@ -555,11 +572,414 @@ def make_server(host: str, port: int, loop: EngineLoop,
                           "finish_reason": "failed"},
                     rid=res.rid)
                 return
-            self._gen_respond(200, {
+            body = {
                 "id": res.rid,
                 "tokens": res.tokens,
                 "text": decode(list(res.prompt) + res.tokens),
                 "finish_reason": res.finish_reason,
-            }, rid=res.rid)
+            }
+            digest = getattr(res, "prefix_digest", ())
+            if digest:
+                # What this replica's radix cache now holds for this
+                # prompt — the fleet router ingests these from the
+                # response body, so affinity needs no tokenizer and no
+                # replica-side push (ISSUE 15).
+                body["prefix_digest"] = list(digest)
+            self._gen_respond(200, body, rid=res.rid)
 
     return ThreadingHTTPServer((host, port), Handler)
+
+
+# ---------------------------------------------------------------------------
+# Fleet router front tier (ISSUE 15): an asyncio HTTP proxy over N
+# engine-replica base URLs, routing POST /generate by radix-prefix
+# affinity (serve/router.py — the SAME policy class the in-process
+# Fleet harness tests) with health-poll-driven readiness, failover
+# re-routing, and aggregated Retry-After hints. asyncio rather than
+# another thread-per-request server: the front tier holds hundreds of
+# in-flight proxied requests that are each 99% waiting on a replica
+# socket — an event loop carries that with one thread, and the
+# blocking urllib legs run on the default executor pool.
+# ---------------------------------------------------------------------------
+
+def _http_json(url: str, *, method: str = "GET", body: Optional[dict]
+               = None, timeout: float = 5.0) -> tuple[int, dict, dict]:
+    """One blocking JSON HTTP call -> (status, body, headers). HTTP
+    error statuses return normally (the proxy forwards them); only
+    transport failures raise (URLError / timeout / bad JSON)."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}"), dict(
+                r.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            payload = {"error": raw.decode(errors="replace")}
+        return e.code, payload, dict(e.headers or {})
+
+
+def resolve_replicas(spec: str, default_port: int = 8000) -> List[str]:
+    """Expand one --replicas entry into base URLs. Plain http://host:port
+    entries pass through; ``dns+http://name:port`` resolves the name's
+    A records (a k8s HEADLESS Service: one record per ready pod) into
+    one URL per address — re-resolved every health interval, which is
+    how the router tracks scale-up/down without redeploys."""
+    if not spec.startswith("dns+http://"):
+        return [spec.rstrip("/")]
+    hostport = spec[len("dns+http://"):].rstrip("/")
+    host, _, port = hostport.partition(":")
+    port = int(port or default_port)
+    try:
+        infos = socket.getaddrinfo(host, port, proto=socket.IPPROTO_TCP)
+    except OSError:
+        return []
+    addrs = sorted({info[4][0] for info in infos})
+    # Bracket IPv6 literals (dual-stack headless Services return AAAA
+    # records too) — an unbracketed v6 host:port is not a URL.
+    return [f"http://[{a}]:{port}" if ":" in a else f"http://{a}:{port}"
+            for a in addrs]
+
+
+class RouterFrontend:
+    """Prefix-affinity routing proxy over replica base URLs.
+
+    Lifecycle: construct, ``start()`` (binds and spawns the event-loop
+    thread; ``port`` is the bound port), ``stop()``. Per replica, every
+    ``health_interval_s``: GET /healthz?ready=1 (readiness — a
+    draining/quarantined/dead replica leaves rotation within one
+    interval), GET /stats (queue depth, active rows, brownout level,
+    the replica's own retry_after_s estimate), and
+    GET /debug/prefix_summary (the authoritative radix digests the
+    approximate router index refreshes from).
+
+    POST /generate proxies to the routed replica. Affinity needs the
+    prompt's digest chain, which needs token ids: requests carrying
+    ``prompt_tokens`` route by affinity; text-only prompts (tokenized
+    replica-side) route by load — documented, not hidden. A transport
+    failure or 503 marks the replica not-ready and re-routes
+    (``fallback``) until the ready set is exhausted; 429/503 responses
+    carry a Retry-After aggregated as the MIN over ready replicas'
+    polled estimates (never just the shedding replica's) and a body
+    naming the ready ``replica_set`` size.
+
+    Own endpoints: GET /healthz[?ready=1] (ready while >= 1 replica
+    is), GET /debug/router (router + per-replica view), GET /metrics
+    (the serve_router_* families).
+    """
+
+    def __init__(self, replicas: List[str], *, host: str = "0.0.0.0",
+                 port: int = 8000, page: int = 16,
+                 health_interval_s: float = 2.0,
+                 request_timeout_s: float = 300.0,
+                 affinity: bool = True, index_cap: int = 8192,
+                 default_port: int = 8000):
+        from nanosandbox_tpu.serve.router import PrefixAffinityRouter
+
+        self._specs = list(replicas)
+        self.host = host
+        self.port = port
+        self.page = int(page)
+        self.health_interval_s = float(health_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.default_port = int(default_port)
+        urls: List[str] = []
+        for spec in self._specs:
+            urls.extend(resolve_replicas(spec, default_port))
+        self.metrics = MetricRegistry()
+        self.router = PrefixAffinityRouter(
+            urls or ["http://unresolved.invalid:0"], page=page,
+            affinity=affinity, index_cap=index_cap,
+            metrics=self.metrics)
+        if not urls:
+            self.router.remove_replica("http://unresolved.invalid:0")
+        self._retry_by_replica: Dict[str, float] = {}
+        # Proxy legs block a thread for the request's whole generation
+        # (up to request_timeout_s): give them their OWN pool so long
+        # decodes can never starve the health polls — which run on the
+        # loop's default executor — out of their interval (the
+        # "leaves rotation within one health interval" contract).
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._proxy_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="router-proxy")
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stopping: Optional[asyncio.Event] = None
+
+    # ------------------------------------------------------------ health
+    def _poll_replica(self, url: str) -> None:
+        """One replica's health refresh (blocking; runs on the
+        executor). Any transport failure = not ready. Per-call timeout
+        is capped at the health interval: a BLACK-HOLED replica (node
+        gone, connections hang instead of refusing) must still leave
+        rotation within ~one interval, not after 3 x 5s of sequential
+        hangs — the 'leaves rotation within one health interval'
+        contract is only as tight as this timeout."""
+        t = max(0.25, min(5.0, self.health_interval_s))
+        try:
+            st, body, _ = _http_json(f"{url}/healthz?ready=1", timeout=t)
+            ready = st == 200 and bool(body.get("ready", body.get("ok")))
+            reason = body.get("reason", "ok" if ready else "not ready")
+            queued = active = brownout = 0
+            if ready:
+                _, stats, _ = _http_json(f"{url}/stats", timeout=t)
+                queued = int(stats.get("queued", 0))
+                active = int(stats.get("active", 0))
+                bo = stats.get("brownout") or {}
+                brownout = int(bo.get("level", 0))
+                retry = stats.get("retry_after_s")
+                if retry is not None:
+                    self._retry_by_replica[url] = float(retry)
+                _, summary, _ = _http_json(f"{url}/debug/prefix_summary",
+                                           timeout=t)
+                self.router.refresh_summary(
+                    url, summary.get("digests") or [])
+        except Exception as e:       # noqa: BLE001 — any poll failure
+            ready, reason = False, f"unreachable: {type(e).__name__}"
+            queued = active = brownout = 0
+        self.router.update_replica(url, ready=ready, reason=reason,
+                                   queued=queued, active=active,
+                                   brownout=brownout,
+                                   retry_after_s=self._retry_by_replica
+                                   .get(url))
+
+    async def _health_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopping.is_set():
+            urls: List[str] = []
+            for spec in self._specs:
+                urls.extend(await loop.run_in_executor(
+                    None, resolve_replicas, spec, self.default_port))
+            if urls:
+                for url in urls:
+                    self.router.add_replica(url)
+                for known in list(self.router.replicas):
+                    if known not in urls:
+                        self.router.remove_replica(known)
+            else:
+                # A resolver blip (kube-dns restart, transient timeout)
+                # must not deregister the whole fleet — that would turn
+                # one failed lookup into a full 503 outage AND discard
+                # every warm prefix index. Keep the known set; the
+                # per-replica polls below mark truly-dead ones
+                # not-ready, which is the correct degradation.
+                urls = list(self.router.replicas)
+            await asyncio.gather(*(
+                loop.run_in_executor(None, self._poll_replica, url)
+                for url in urls), return_exceptions=True)
+            try:
+                await asyncio.wait_for(self._stopping.wait(),
+                                       self.health_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def retry_after_s(self) -> float:
+        """Aggregate backoff hint: min over READY replicas of their
+        own polled estimates (satellite 2) — fallback 1s when cold."""
+        ready = self.router.ready_replicas()
+        vals = [self._retry_by_replica[r] for r in ready
+                if r in self._retry_by_replica]
+        return min(vals) if vals else 1.0
+
+    # ------------------------------------------------------------- serve
+    async def _respond(self, writer: asyncio.StreamWriter, code: int,
+                       body: dict, headers: Optional[dict] = None
+                       ) -> None:
+        phrase = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests", 502: "Bad Gateway",
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(code, "OK")
+        data = json.dumps(body).encode()
+        head = [f"HTTP/1.1 {code} {phrase}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(data)}", "Connection: close"]
+        for k, v in (headers or {}).items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + data)
+        await writer.drain()
+        writer.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = (await reader.readline()).decode()
+            parts = request_line.split()
+            if len(parts) < 2:
+                writer.close()
+                return
+            method, path = parts[0], parts[1]
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                if name.strip().lower() == "content-length":
+                    length = int(value.strip())
+            raw = await reader.readexactly(length) if length else b""
+            await self._route_request(method, path, raw, writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            writer.close()
+        except Exception as e:      # noqa: BLE001 — proxy must answer
+            try:
+                await self._respond(writer, 502,
+                                    {"error": f"router error: {e!r}"})
+            except ConnectionError:
+                writer.close()
+
+    async def _route_request(self, method: str, path: str, raw: bytes,
+                             writer: asyncio.StreamWriter) -> None:
+        url = urllib.parse.urlsplit(path)
+        if method == "GET" and url.path == "/healthz":
+            ready = bool(self.router.ready_replicas())
+            body = {"ok": ready, "ready": ready,
+                    "replica_set": len(self.router.ready_replicas()),
+                    "replicas": len(self.router.replicas)}
+            await self._respond(writer, 200 if ready else 503, body)
+            return
+        if method == "GET" and url.path == "/debug/router":
+            await self._respond(writer, 200, {
+                "router": self.router.stats(),
+                "retry_after_s": self.retry_after_s(),
+                "health_interval_s": self.health_interval_s})
+            return
+        if method == "GET" and url.path == "/metrics":
+            data = render_prometheus(self.metrics).encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
+                b"version=0.0.4; charset=utf-8\r\nContent-Length: "
+                + str(len(data)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + data)
+            await writer.drain()
+            writer.close()
+            return
+        if method != "POST" or url.path != "/generate":
+            await self._respond(writer, 404,
+                                {"error": f"no route {path}"})
+            return
+        try:
+            payload = json.loads(raw or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            await self._respond(writer, 400,
+                                {"error": f"bad request: {e!r}"})
+            return
+        chain: List[str] = []
+        if isinstance(payload.get("prompt_tokens"), list) and self.page:
+            from nanosandbox_tpu.serve.paged import prefix_digests
+            try:
+                chain = prefix_digests(
+                    [int(t) for t in payload["prompt_tokens"]], self.page)
+            except (TypeError, ValueError):
+                chain = []
+        await self._proxy_generate(payload, chain, writer)
+
+    async def _proxy_generate(self, payload: dict, chain: List[str],
+                              writer: asyncio.StreamWriter) -> None:
+        from nanosandbox_tpu.serve.router import NoReadyReplicaError
+
+        loop = asyncio.get_running_loop()
+        tried: set = set()
+        slo = payload.get("slo_class")
+        while True:
+            try:
+                dec = self.router.route(chain, exclude=tried,
+                                        failover=bool(tried))
+            except NoReadyReplicaError as e:
+                await self._respond(
+                    writer, 503,
+                    {"error": str(e), "replica_set": 0,
+                     "tried": sorted(tried)},
+                    {"Retry-After": max(1, math.ceil(
+                        self.retry_after_s()))})
+                return
+            name = dec.replica
+            if chain:
+                # Optimistic insert (the Fleet.submit comment): a
+                # same-prefix follower in the same burst must route
+                # here too, not wait for this request to finish.
+                self.router.observe_digests(name, chain)
+            try:
+                status, body, headers = await loop.run_in_executor(
+                    self._proxy_pool, lambda: _http_json(
+                        f"{name}/generate", method="POST", body=payload,
+                        timeout=self.request_timeout_s))
+            except Exception as e:   # noqa: BLE001 — transport failure
+                self.router.update_replica(
+                    name, ready=False,
+                    reason=f"unreachable: {type(e).__name__}")
+                tried.add(name)
+                continue
+            if status == 503:
+                # This replica is leaving (drain/quarantine/failure):
+                # out of rotation now, re-route the request.
+                self.router.update_replica(name, ready=False,
+                                           reason="503 from replica")
+                tried.add(name)
+                continue
+            body.setdefault("replica", name)
+            extra_headers = {}
+            if status == 429:
+                # Aggregated hint: the retrying client will be routed
+                # to the BEST replica, so the fleet-wide minimum is the
+                # binding number, not the shedding replica's own.
+                ready = self.router.ready_replicas()
+                body["replica_set"] = len(ready)
+                agg = self.retry_after_s()
+                own = self._retry_by_replica.get(name)
+                if own is not None:
+                    agg = min(agg, own)
+                extra_headers["Retry-After"] = max(1, math.ceil(agg))
+            elif "Retry-After" in headers:
+                extra_headers["Retry-After"] = headers["Retry-After"]
+            if status == 200 and body.get("prefix_digest"):
+                self.router.observe_digests(
+                    name, list(body["prefix_digest"]))
+            await self._respond(writer, status, body, extra_headers)
+            return
+
+    # ---------------------------------------------------------- lifecycle
+    async def _main(self) -> None:
+        self._stopping = asyncio.Event()
+        server = await asyncio.start_server(self._handle, self.host,
+                                            self.port)
+        self.port = server.sockets[0].getsockname()[1]
+        health = asyncio.create_task(self._health_loop())
+        self._started.set()
+        async with server:
+            await self._stopping.wait()
+        health.cancel()
+
+    def start(self) -> "RouterFrontend":
+        """Bind + serve on a daemon thread; returns self once the port
+        is bound (tests pass port=0 and read .port)."""
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._main())
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-router")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("router frontend failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stopping is not None:
+            self._loop.call_soon_threadsafe(self._stopping.set)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._proxy_pool.shutdown(wait=False)

@@ -48,6 +48,7 @@ Prefix sharing — block-aligned, copy-on-write by refcount:
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -55,6 +56,35 @@ from typing import Dict, List, Optional, Sequence
 def blocks_for(n_positions: int, page: int) -> int:
     """ceil(n_positions / page): blocks covering n_positions tokens."""
     return -(-n_positions // page)
+
+
+def _block_digest(prev: bytes, block: Sequence[int]) -> bytes:
+    """Chained 64-bit fingerprint of one page-sized token block given
+    its parent chain's digest — the ONE hash both prefix_digests() and
+    RadixPrefixCache.digests() use, so a router matching request chains
+    against replica summaries can never drift from the trie itself."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(prev)
+    h.update(",".join(str(int(t)) for t in block).encode())
+    return h.digest()
+
+
+def prefix_digests(tokens: Sequence[int], page: int) -> List[str]:
+    """Chained per-block fingerprints of a token sequence's FULL
+    page-sized blocks (the shareable region of a prompt — exactly what
+    the radix cache can ever hold). Entry i fingerprints the whole
+    prefix tokens[:(i+1)*page], so two sequences share a digest iff
+    they share that block-aligned prefix, and a router-side index needs
+    only MEMBERSHIP (a contiguous walk down the request's own chain) to
+    estimate a replica's resident hit. Digests are hex strings — stable
+    across processes, JSON-safe for Result/flight/HTTP reporting (the
+    ISSUE 15 fleet-router contract)."""
+    out: List[str] = []
+    prev = b""
+    for i in range(len(tokens) // page):
+        prev = _block_digest(prev, tokens[i * page:(i + 1) * page])
+        out.append(prev.hex())
+    return out
 
 
 class _Node:
@@ -205,6 +235,26 @@ class RadixPrefixCache:
 
     def cached_blocks(self) -> List[int]:
         return [n.block for n in self._nodes]
+
+    def digests(self) -> List[str]:
+        """Chained fingerprints of every resident chain prefix — one
+        per trie node, computed with the same _block_digest chain
+        prefix_digests() applies to a prompt, so ``set(digests())``
+        answers "would this prompt's block i hit here" by membership
+        alone. This is the authoritative summary the fleet router's
+        approximate per-replica index refreshes from
+        (GET /debug/prefix_summary): anything the LRU evicted since the
+        last refresh drops out of the set, which is the router index's
+        staleness eviction."""
+        out: List[str] = []
+        stack = [(child, b"") for child in self.root.children.values()]
+        while stack:
+            node, prev = stack.pop()
+            d = _block_digest(prev, node.key)
+            out.append(d.hex())
+            for c in node.children.values():
+                stack.append((c, d))
+        return out
 
 
 @dataclass

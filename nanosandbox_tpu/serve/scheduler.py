@@ -284,6 +284,24 @@ class SlotScheduler:
             self._class(self._prio(item)).appendleft(item)
             self._n += 1
 
+    def queue_mass(self, priority: int) -> Tuple[int, int]:
+        """(at_or_above, strictly_above) queued counts relative to
+        ``priority`` — the backlog a request of that class waits behind
+        (retry-after hints, fleet-router load weighting). Safe from
+        HTTP handler threads: per-class len() reads on a snapshot of
+        the class list, never a live deque iteration."""
+        ahead = jumps = 0
+        for np in list(self._negprios):
+            q = self._queues.get(-np)
+            if q is None:
+                continue
+            n = len(q)
+            if -np >= priority:
+                ahead += n
+            if -np > priority:
+                jumps += n
+        return ahead, jumps
+
     def queued_items(self) -> List:
         """Snapshot of the queue, head first (the /debug/scheduler
         view; callers must not mutate the items). Safe from HTTP

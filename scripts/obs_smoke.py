@@ -202,6 +202,15 @@ def main() -> int:
         sched = json.loads(get("/debug/scheduler"))
         assert {"queue", "free_slots", "prefill_buckets",
                 "shed"} <= set(sched), sorted(sched)
+        # the fleet router's authoritative index-refresh surface
+        # (ISSUE 15): enabled on this paged engine, digests are the
+        # 16-hex chained block fingerprints
+        summary = json.loads(get("/debug/prefix_summary"))
+        assert summary["enabled"] is True, summary
+        assert summary["page"] == 16, summary
+        assert summary["blocks"] == len(summary["digests"])
+        assert all(isinstance(d, str) and len(d) == 16
+                   for d in summary["digests"]), summary
 
         health = json.loads(get("/healthz"))
         assert health == {"ok": True}, health

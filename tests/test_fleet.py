@@ -1,0 +1,595 @@
+"""Prefix-affinity fleet router tests (ISSUE 15).
+
+The contract under test:
+  * Digests: paged.prefix_digests chains per-block fingerprints of the
+    FULL prompt blocks; the radix cache's digests() walk agrees with
+    them, Result/flight/prefix_summary all report the same chain, and
+    the router matches by contiguous membership.
+  * Routing: shared-system-prompt requests land on the warm replica
+    (measured hit-rate strictly above the seeded-random twin on the
+    identical workload); a drained or quarantined replica leaves
+    rotation within one health interval (= one fleet step in-process);
+    greedy outputs are token-identical whichever replica serves,
+    including across a mid-flight replica kill and failover restitch.
+  * Identity: flight rids are replica-namespaced; the merged fleet
+    JSONL has exactly ONE terminal per rid across a router failover
+    (fuzzed over kill steps).
+  * Backoff: fleet retry_after_s is the min over READY replicas of the
+    per-replica queue-mass-weighted estimate; retry_info names the
+    ready replica-set size (the 429 body contract).
+  * Cost: the router adds zero compiled programs and zero audited host
+    syncs — per-replica compile sets are byte-identical to a solo
+    engine's.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT
+from nanosandbox_tpu.obs import TERMINAL_EVENTS, render_prometheus
+from nanosandbox_tpu.serve import (Engine, FaultPlan, Fleet,
+                                   NoReadyReplicaError,
+                                   PrefixAffinityRouter, prefix_digests)
+from nanosandbox_tpu.serve.paged import RadixPrefixCache, _block_digest
+from nanosandbox_tpu.serve.router import _PrefixIndex
+from nanosandbox_tpu.utils import tracecheck as _tracecheck
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _fleet(served_model, n=2, **kw):
+    cfg, model, params = served_model
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 64)
+    return Fleet(model, params, n_replicas=n, **kw)
+
+
+def _grouped_requests(vocab, n_groups=2, per_group=5, prefix=35,
+                      budget=3, seed=0):
+    """Shared-system-prompt mix: n_groups prefixes, each with
+    per_group short-suffix followers, interleaved round-robin."""
+    rng = np.random.default_rng(seed)
+    groups = [rng.integers(0, vocab, prefix).tolist()
+              for _ in range(n_groups)]
+    out = []
+    for i in range(n_groups * per_group):
+        g = groups[i % n_groups]
+        sfx = rng.integers(0, vocab,
+                           int(rng.integers(1, 6))).tolist()
+        out.append((g + sfx, budget))
+    return out
+
+
+def _reference(served_model, requests):
+    """Solo-engine oracle: greedy tokens per prompt (batch- and
+    prefix-hit-independent, both pinned elsewhere)."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    want = {}
+    for prompt, budget in requests:
+        if tuple(prompt) in want:
+            continue
+        eng.submit(prompt, budget)
+        want[tuple(prompt)] = eng.drain()[-1].tokens
+    return want
+
+
+# ------------------------------------------------------------- digests
+
+def test_prefix_digests_chain_properties():
+    toks = list(range(50))
+    d = prefix_digests(toks, 16)
+    assert len(d) == 3                       # only FULL blocks
+    assert prefix_digests(toks, 16) == d     # deterministic
+    assert prefix_digests(toks[:48], 16) == d  # trailing partial ignored
+    assert prefix_digests(toks[:32], 16) == d[:2]  # chain is a prefix
+    # changing an EARLY token changes every later digest (chained)
+    d2 = prefix_digests([99] + toks[1:], 16)
+    assert all(a != b for a, b in zip(d, d2))
+    # hex strings, JSON-safe
+    assert all(isinstance(x, str) and len(x) == 16 for x in d)
+    assert prefix_digests(toks[:15], 16) == []
+
+
+def test_cache_digests_agree_with_prompt_digests():
+    cache = RadixPrefixCache(4)
+    prompt = tuple(range(12))
+    cache.insert_chain(prompt, [0, 1, 2], 0)
+    assert sorted(cache.digests()) == sorted(prefix_digests(prompt, 4))
+    # shared-prefix second chain adds only the divergent tail digest
+    p2 = prompt[:8] + (90, 91, 92, 93)
+    cache.insert_chain(p2, [0, 1, 3], 0)
+    want = set(prefix_digests(prompt, 4)) | set(prefix_digests(p2, 4))
+    assert set(cache.digests()) == want
+    # _block_digest is the shared primitive (drift guard)
+    assert prefix_digests(prompt, 4)[0] == _block_digest(
+        b"", prompt[:4]).hex()
+
+
+def test_engine_reports_prefix_digest(served_model):
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    prompt = list(range(40))
+    eng.submit(prompt, 3)
+    res = eng.drain()[0]
+    want = tuple(prefix_digests(prompt, eng.kv_page_size))
+    assert res.prefix_digest == want
+    summ = eng.prefix_summary()
+    assert summ["enabled"] and summ["page"] == eng.kv_page_size
+    assert set(want) <= set(summ["digests"])
+    fin = [e for e in eng.flight.events() if e["ev"] == "finish"]
+    assert fin[0]["prefix_digest"] == list(want)
+    # dense / cache-less engines report nothing (no placeholder noise)
+    dense = Engine(model, params, num_slots=2, max_len=64, paged=False)
+    dense.submit(prompt, 2)
+    assert dense.drain()[0].prefix_digest == ()
+    assert dense.prefix_summary() == {"enabled": False, "page": 0,
+                                      "blocks": 0, "digests": []}
+
+
+# -------------------------------------------------------------- router
+
+def test_router_index_membership_and_lru():
+    ix = _PrefixIndex(cap=3)
+    ix.add_chain(["a", "b", "c"])
+    assert ix.match_blocks(["a", "b", "c"]) == 3
+    assert ix.match_blocks(["a", "b", "x"]) == 2
+    assert ix.match_blocks(["x", "b", "c"]) == 0   # contiguity
+    ix.add_chain(["d"])                            # cap 3: evicts LRU
+    assert len(ix) == 3
+    ix.replace(["z"])                              # authoritative
+    assert ix.match_blocks(["a"]) == 0 and ix.match_blocks(["z"]) == 1
+
+
+def test_router_reasons_and_scoring():
+    r = PrefixAffinityRouter(["r0", "r1"], page=16)
+    r.update_replica("r0", ready=True)
+    r.update_replica("r1", ready=True)
+    chain = prefix_digests(list(range(32)), 16)
+    dec = r.route(chain)
+    assert dec.reason == "load" and dec.candidates == 2
+    r.observe_digests("r0", chain)
+    dec = r.route(chain)
+    assert (dec.replica, dec.reason) == ("r0", "affinity")
+    assert dec.est_hit_tokens == 32
+    # load can outweigh a small hit
+    r.update_replica("r0", ready=True, queued=100, active=2)
+    assert r.route(chain).replica == "r1"
+    # exclusion / failover tag
+    r.update_replica("r0", ready=True)
+    assert r.route(chain, exclude=("r0",)).reason == "fallback"
+    assert r.route(chain, failover=True).reason == "fallback"
+    # warm replica out of rotation -> redirected traffic is 'fallback'
+    r.update_replica("r0", ready=False, reason="draining")
+    dec = r.route(chain)
+    assert (dec.replica, dec.reason) == ("r1", "fallback")
+    r.update_replica("r1", ready=False, reason="draining")
+    with pytest.raises(NoReadyReplicaError):
+        r.route(chain)
+
+
+def test_router_summary_refresh_evicts_stale():
+    r = PrefixAffinityRouter(["r0"], page=16)
+    r.update_replica("r0", ready=True)
+    chain = prefix_digests(list(range(48)), 16)
+    r.observe_digests("r0", chain)
+    assert r.match_tokens("r0", chain) == 48
+    # replica evicted the tail block since the last report
+    r.refresh_summary("r0", chain[:1])
+    assert r.match_tokens("r0", chain) == 16
+    r.forget("r0")
+    assert r.match_tokens("r0", chain) == 0
+
+
+# --------------------------------------------------------------- fleet
+
+def test_affinity_beats_random_hit_rate(served_model):
+    cfg, _, _ = served_model
+    # THREE groups over two replicas: coprime with the random twin's
+    # rotation, so round-robin cannot accidentally reproduce affinity
+    # (with 2 groups it aliases into it and both twins tie).
+    reqs = _grouped_requests(cfg.vocab_size, n_groups=3, per_group=3)
+
+    def hit_rate(affinity):
+        fleet = _fleet(served_model, affinity=affinity)
+        it = iter(reqs)
+        pending = len(reqs)
+        while pending or fleet.has_work():
+            q = next(it, None)
+            if q is not None:
+                fleet.submit(q[0], q[1])
+                pending -= 1
+            fleet.step()
+            fleet.step()
+        st = fleet.stats()
+        hits = sum(v["prefix_hit_tokens"]
+                   for v in st["replicas"].values())
+        miss = sum(v["prefix_miss_tokens"]
+                   for v in st["replicas"].values())
+        return hits / (hits + miss), st
+
+    aff, aff_st = hit_rate(True)
+    rand, _ = hit_rate(False)
+    # Strictly above the random twin (the satellite-3 pin): affinity
+    # keeps each group on one replica, random pays one cold prefill
+    # per (group, replica) pair.
+    assert aff > rand, (aff, rand)
+    assert aff_st["router"]["decisions"]["affinity"] > 0
+
+
+def test_fleet_greedy_parity_whichever_replica(served_model):
+    cfg, _, _ = served_model
+    # Random routing spreads the groups across BOTH replicas, so one
+    # twin exercises "whichever replica serves"; the affinity twin's
+    # parity rides in the failover test and the bench oracle.
+    reqs = _grouped_requests(cfg.vocab_size, n_groups=3, per_group=3,
+                             seed=5)
+    want = _reference(served_model, reqs)
+    fleet = _fleet(served_model, affinity=False)
+    for prompt, budget in reqs:
+        fleet.submit(prompt, budget)
+    results = fleet.drain()
+    assert len(results) == len(reqs)
+    served = {r.rid.split(":")[0] for r in results}
+    assert served == {"r0", "r1"}        # both replicas actually served
+    for r in results:
+        assert r.tokens == want[tuple(r.prompt)], r.rid
+        assert r.finish_reason == "length"
+
+
+def test_drain_and_quarantine_leave_rotation(served_model):
+    fleet = _fleet(served_model)
+    fleet.drain_replica("r0")
+    assert fleet.router.ready_replicas() == ["r1"]
+    rid = fleet.submit(list(range(20)), 2)
+    assert rid.startswith("r1:")
+    fleet.undrain_replica("r0")
+    assert fleet.router.ready_replicas() == ["r0", "r1"]
+    # quarantine leaves rotation within one health interval (= 1 step)
+    fleet.replicas["r1"].quarantine("test")
+    fleet.step()
+    assert fleet.router.ready_replicas() == ["r0"]
+    assert fleet.submit(list(range(20)), 2).startswith("r0:")
+    fleet.drain()
+    # all replicas out -> NoReadyReplicaError (503 upstream)
+    fleet.drain_replica("r0")
+    with pytest.raises(NoReadyReplicaError):
+        fleet.submit([1, 2, 3], 2)
+
+
+@pytest.mark.parametrize("kill_step", [
+    2,
+    pytest.param(5, marks=pytest.mark.slow),
+    pytest.param(9, marks=pytest.mark.slow),
+])
+def test_replica_down_failover_exactly_once_and_parity(
+        served_model, kill_step):
+    """The satellite-1 fuzz pin, across kill timings: one replica
+    hard-dies mid-traffic; every fleet request reaches exactly one
+    fleet Result, the merged namespaced ledger carries exactly one
+    terminal per rid, and greedy outputs are token-identical to an
+    undisturbed run (failover restitch)."""
+    cfg, _, _ = served_model
+    reqs = _grouped_requests(cfg.vocab_size, per_group=4, budget=5,
+                             seed=kill_step)
+    want = _reference(served_model, reqs)
+    fleet = _fleet(served_model,
+                   faults=FaultPlan.parse(f"replica_down@{kill_step}"))
+    rids = [fleet.submit(p, b) for p, b in reqs]
+    results = fleet.drain()
+    assert fleet.replica_downs == 1
+    assert len(results) == len(reqs)
+    assert sorted(r.rid for r in results) == sorted(rids)
+    for r in results:
+        assert r.finish_reason == "length", (r.rid, r.finish_reason)
+        assert r.tokens == want[tuple(r.prompt)], r.rid
+    terminals = {}
+    for e in fleet.merged_flight_events():
+        if e["ev"] in TERMINAL_EVENTS and e.get("rid") is not None:
+            terminals[e["rid"]] = terminals.get(e["rid"], 0) + 1
+    assert all(n == 1 for n in terminals.values()), terminals
+    # victims really moved: at least one failover event with salvage
+    if fleet.failovers:
+        evs = [e for e in fleet.flight.events() if e["ev"] == "failover"]
+        assert evs and all(e["dead"] != e["replica"] for e in evs)
+
+
+def test_out_of_vocab_prompt_rejects_not_poisons(served_model):
+    """The poison-pill vector closed at the boundary: an out-of-range
+    token id would NaN-fill the embedding gather, trip the poison
+    sentinel, and burn the recovery supervisor to PERMANENT failure —
+    one malformed request killing the replica (and, pre-fence, the
+    fleet via failover). It must be a plain reject (400 upstream)."""
+    cfg, model, params = served_model
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    with pytest.raises(ValueError, match="token_out_of_range|outside"):
+        eng.submit([1, 2, cfg.vocab_size], 3)
+    with pytest.raises(ValueError, match="outside"):
+        eng.submit([-1], 3)
+    assert eng.rejected.get("token_out_of_range") == 2
+    assert eng.poisoned_steps == 0 and not eng.failed
+    eng.submit([1, 2, 3], 2)              # engine still healthy
+    assert eng.drain()[0].finish_reason == "length"
+
+
+def test_failover_cap_fences_poison_pills(served_model):
+    """max_failovers=0: a kill victim surfaces 'failed' even though a
+    healthy replica remains — the fence that stops a replica-killing
+    request from cascading through the whole fleet."""
+    cfg, _, _ = served_model
+    fleet = _fleet(served_model, max_failovers=0,
+                   faults=FaultPlan.parse("replica_down@2"))
+    for p, b in _grouped_requests(cfg.vocab_size, per_group=3, budget=5):
+        fleet.submit(p, b)
+    results = fleet.drain()
+    assert fleet.failovers == 0
+    assert any(r.finish_reason == "failed" for r in results)
+    assert len(fleet.router.ready_replicas()) == 1   # fleet survives
+    rid = fleet.submit([1, 2, 3], 2)                 # and still serves
+    assert fleet.drain()[0].rid == rid
+
+
+def test_failover_off_surfaces_failed(served_model):
+    cfg, _, _ = served_model
+    fleet = _fleet(served_model, failover=False,
+                   faults=FaultPlan.parse("replica_down@2"))
+    reqs = _grouped_requests(cfg.vocab_size, per_group=3, budget=5)
+    for p, b in reqs:
+        fleet.submit(p, b)
+    results = fleet.drain()
+    assert len(results) == len(reqs)
+    assert any(r.finish_reason == "failed" for r in results)
+    assert fleet.failovers == 0
+
+
+def test_retry_after_aggregates_min_over_ready(served_model):
+    fleet = _fleet(served_model)
+    # load r1's queue so its estimate exceeds r0's
+    eng1 = fleet.replicas["r1"]
+    for _ in range(12):
+        eng1.submit([1, 2, 3], 2)
+    base0 = fleet.replicas["r0"].retry_after_s()
+    base1 = eng1.retry_after_s()
+    assert fleet.retry_after_s() == min(base0, base1)
+    info = fleet.retry_info()
+    assert info["replica_set"] == 2
+    # the loaded replica alone would have quoted a bigger number
+    fleet.drain_replica("r0")
+    assert fleet.retry_info()["replica_set"] == 1
+    assert fleet.retry_after_s() == eng1.retry_after_s()
+    fleet.replicas["r1"].drain()
+
+
+def test_router_metrics_families_and_stats(served_model):
+    cfg, _, _ = served_model
+    fleet = _fleet(served_model)
+    for p, b in _grouped_requests(cfg.vocab_size, per_group=2):
+        fleet.submit(p, b)
+    fleet.drain()
+    text = render_prometheus(fleet.metrics)
+    assert "serve_router_decisions_total" in text
+    assert 'serve_router_replica_ready{replica="r0"}' in text
+    assert "serve_router_prefix_hit_est_tokens" in text
+    st = fleet.stats()
+    assert "router" in st and "decisions" in st["router"]
+    assert set(st["router"]["replicas"]) == {"r0", "r1"}
+    json.dumps(st)                       # /debug-able
+    # label hygiene: only reasons that actually happened mint children
+    reasons = {line.split('reason="')[1].split('"')[0]
+               for line in text.splitlines()
+               if line.startswith("serve_router_decisions_total{")}
+    assert reasons <= {"affinity", "load", "fallback"}
+    assert "fallback" not in reasons     # nothing failed over here
+
+
+def test_flight_rid_namespacing_and_merge(served_model):
+    fleet = _fleet(served_model)
+    rid = fleet.submit(list(range(20)), 2)
+    fleet.drain()
+    assert rid.split(":")[0] in ("r0", "r1")
+    replica = rid.split(":")[0]
+    eng = fleet.replicas[replica]
+    evs = eng.flight.events()
+    assert all(isinstance(e["rid"], str) and e["rid"].startswith(replica)
+               for e in evs if e.get("rid") is not None)
+    # engine-internal int-rid lookups still work (the /debug contract)
+    int_rid = int(rid.split(":")[1])
+    assert eng.flight.events(rid=int_rid)
+    assert eng.flight.terminals(int_rid) == ["finish"]
+    # merged JSONL parses and carries the route event
+    lines = fleet.merged_flight_jsonl().strip().splitlines()
+    parsed = [json.loads(ln) for ln in lines]
+    assert any(e["ev"] == "route" and e["rid"] == rid for e in parsed)
+    # wall-clock ordering across recorders
+    walls = [e["wall"] for e in parsed]
+    assert walls == sorted(walls)
+
+
+def test_fleet_adds_no_programs_and_no_syncs(served_model):
+    """The acceptance pin: routing is host-side bookkeeping — each
+    replica's compile set is byte-identical to a solo engine's and the
+    audited host-sync ledger gains nothing."""
+    cfg, model, params = served_model
+    reqs = _grouped_requests(cfg.vocab_size, per_group=2)
+
+    mark = _tracecheck.sync_counts()
+    solo = Engine(model, params, num_slots=2, max_len=64)
+    for p, b in reqs:
+        solo.submit(p, b)
+    solo.drain()
+    solo_sync = _tracecheck.sync_delta(mark)
+
+    mark = _tracecheck.sync_counts()
+    fleet = _fleet(served_model)
+    for p, b in reqs:
+        fleet.submit(p, b)
+    fleet.drain()
+    fleet_sync = _tracecheck.sync_delta(mark)
+
+    for eng in fleet.replicas.values():
+        assert eng.max_programs() == solo.max_programs()
+        for kind, count in eng.trace_counts.items():
+            assert count <= eng.max_programs()[kind], kind
+    assert set(fleet_sync) == set(solo_sync)
+
+
+def test_priority_and_slo_passthrough(served_model):
+    fleet = _fleet(served_model)
+    rid = fleet.submit(list(range(30)), 2, slo_class="interactive",
+                       priority=7, deadline_s=30.0, temperature=0.0,
+                       seed=3)
+    name, erid = rid.split(":")
+    # parked in the chosen engine's queue with every field intact
+    item = fleet.replicas[name].sched.queued_items()[0]
+    assert (item.slo_class, item.priority, item.deadline_s) == \
+        ("interactive", 7, 30.0)
+    assert item.rid == int(erid)
+    fleet.drain()
+
+
+# ------------------------------------------------------ HTTP front tier
+
+def _start_replica_server(model, params):
+    from nanosandbox_tpu.serve.http import EngineLoop, make_server
+
+    eng = Engine(model, params, num_slots=2, max_len=64)
+    loop = EngineLoop(eng)
+    loop.start()
+    srv = make_server("127.0.0.1", 0, loop,
+                      lambda s: [ord(c) % 50 for c in s] or [0],
+                      lambda ids: "".join(chr(65 + t % 26) for t in ids))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return eng, loop, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post(port, path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_http_router_tier_end_to_end(served_model):
+    """The (b) landing: asyncio front tier over two REAL replica
+    servers — affinity keeps a shared prefix on one replica, the
+    response body carries replica + prefix_digest, /metrics exposes
+    the router families, and a drained replica leaves rotation within
+    one health interval with traffic re-routed (fallback)."""
+    from nanosandbox_tpu.serve.http import RouterFrontend
+
+    cfg, model, params = served_model
+    nodes = [_start_replica_server(model, params) for _ in range(2)]
+    fe = RouterFrontend([n[3] for n in nodes], host="127.0.0.1",
+                        port=0, health_interval_s=0.1).start()
+    try:
+        deadline = time.time() + 5
+        while len(fe.router.ready_replicas()) < 2:
+            assert time.time() < deadline, fe.router.stats()
+            time.sleep(0.05)
+        st, body, _ = _post(fe.port, "/generate",
+                            {"prompt_tokens": list(range(40)),
+                             "max_new_tokens": 3})
+        assert st == 200 and body["finish_reason"] == "length"
+        warm = body["replica"]
+        assert body["prefix_digest"] == prefix_digests(
+            list(range(40)), 16)
+        st, body2, _ = _post(fe.port, "/generate",
+                             {"prompt_tokens": list(range(32)) + [45],
+                              "max_new_tokens": 2})
+        assert st == 200 and body2["replica"] == warm
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fe.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "serve_router_decisions_total" in text
+        # replica /debug/prefix_summary serves the digests
+        warm_port = int(warm.rsplit(":", 1)[1])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{warm_port}/debug/prefix_summary",
+                timeout=10) as r:
+            summ = json.load(r)
+        assert set(body["prefix_digest"]) <= set(summ["digests"])
+        # drain the warm replica: rotation reacts within one interval
+        _post(warm_port, "/drain", {})
+        deadline = time.time() + 5
+        while warm in fe.router.ready_replicas():
+            assert time.time() < deadline, fe.router.stats()
+            time.sleep(0.05)
+        st, body3, _ = _post(fe.port, "/generate",
+                             {"prompt_tokens": list(range(32)) + [44],
+                              "max_new_tokens": 2})
+        assert st == 200 and body3["replica"] != warm
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fe.port}/debug/router",
+                timeout=10) as r:
+            dbg = json.load(r)
+        assert dbg["router"]["decisions"]["fallback"] >= 1
+    finally:
+        fe.stop()
+        for eng, loop, srv, _ in nodes:
+            loop.stop()
+            srv.shutdown()
+
+
+def test_http_router_all_down_503(served_model):
+    from nanosandbox_tpu.serve.http import RouterFrontend
+
+    fe = RouterFrontend(["http://127.0.0.1:1"], host="127.0.0.1",
+                        port=0, health_interval_s=0.1).start()
+    try:
+        time.sleep(0.3)
+        st, body, headers = _post(fe.port, "/generate",
+                                  {"prompt_tokens": [1, 2],
+                                   "max_new_tokens": 1})
+        assert st == 503
+        assert body["replica_set"] == 0
+        assert int(headers.get("Retry-After", "0")) >= 1
+    finally:
+        fe.stop()
+
+
+# --------------------------------------------------------------- bench
+
+@pytest.mark.slow
+def test_bench_fleet_smoke():
+    """bench.py --mode=fleet contract: the pinned fields exist and the
+    structural invariants (parity, exactly-once, replica kill) hold on
+    a minimal configuration."""
+    import bench
+
+    result = bench.bench_fleet(
+        {"requests": "8", "groups": "2", "repeat": "1",
+         "num_slots": "2", "max_len": "64", "kill_step": "3"},
+        quick=True, on_tpu=False)
+    x = result["extra"]
+    for fld in ("affinity_vs_random_ttft", "affinity_vs_random_ttft_mean",
+                "hit_rate_affinity", "hit_rate_random",
+                "fleet_greedy_parity", "multi_terminal_rids", "kill"):
+        assert fld in x, fld
+    assert x["fleet_greedy_parity"] == 1.0
+    assert x["multi_terminal_rids"] == 0
+    assert x["kill"]["unreached_terminals"] == 0
+    assert x["kill"]["replica_downs"] == 1
+    assert x["kill"]["kill_parity_ok"]
+    json.dumps(result)                   # the CI artifact serializes
