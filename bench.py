@@ -24,7 +24,7 @@ Usage: python bench.py [--quick] [--batch_size=N] [--iters=N] [--impl=NAME]
            [--requests=N] [--load=1,2] [--burst=6] \
            [--interactive_share=F] [--emit_obs] \
            [--faults=chaos-smoke] [--flight_out=PATH] \
-           [--sched] [--prefill_chunk=N]
+           [--sched] [--disagg] [--prefill_chunk=N]
 
 --mode=serve is the closed-loop load generator (Poisson arrivals at
 multiples of measured capacity, per-class deadlines, an all-at-once
@@ -52,6 +52,16 @@ per-class attainment (CI pins interactive strictly above the FIFO
 twin); and a PREEMPT-RESUME PARITY probe — a preempt_storm fault plan
 repeatedly evicting victims, outputs compared token-for-token against
 a clean twin (CI pins parity == 1.0).
+
+--disagg adds the ISSUE-16 disaggregation probe (extra.disagg): a
+DisaggPair (prefill tier + decode tier, paged block chains as the
+migration wire format) vs the chunked-colocated engine under the SAME
+prefill storm, in the same interleaved rotated rounds. Emits decode-
+tier tpot_p99_under_storm vs the chunked twin and their ratio (CI pins
+<= 1.0 — the decode tier never sees a prefill dispatch, so chunking's
+residual interleave tax disappears), migration latency p50/p99, the
+decode-tier dispatch ledger (CI pins prefill dispatches == 0), and a
+greedy token-parity count vs colocated (CI pins parity == 1.0).
 
 --emit_obs attaches the obs metric-registry snapshot (the same series a
 live /metrics scrape exposes) to the JSON under "obs".
@@ -1031,6 +1041,159 @@ def _bench_serve_scheduling(build_engine, *, cfg, num_slots, max_len,
             "parity_probe_requests": len(par_reqs)}
 
 
+def _bench_serve_disagg(model, params, *, cfg, num_slots, max_len,
+                        chunk, quick, paged, kv_page) -> dict:
+    """The ISSUE-16 disaggregation probe (--disagg): DisaggPair vs the
+    chunked-colocated engine under the SAME prefill storm, in the same
+    interleaved rotated rounds the chunked/unchunked twin uses.
+
+    Chunking PACES the storm inside one engine (ISSUE 13 pinned the
+    chunked/unchunked TPOT ratio); disaggregation REMOVES it — the
+    decode tier never sees a prefill dispatch, so its inter-token gaps
+    should beat even the chunked twin's. Also emits migration latency
+    p50/p99 and the decode-tier dispatch ledger (the zero-prefill
+    assertion CI pins), plus a greedy parity count between the
+    disaggregated and colocated outputs."""
+    import time
+
+    import numpy as np
+
+    from nanosandbox_tpu.serve import DisaggPair, Engine
+
+    rounds = 3 if quick else 5
+    n_dec = max(2, num_slots // 2)
+    dec_budget = max(8, max_len - 12)
+    storm_len = max_len - 2
+    n_storm = num_slots
+    missing = 0
+
+    def build_pair():
+        return DisaggPair(model, params, num_slots=num_slots,
+                          max_len=max_len, pipeline=True, paged=True,
+                          kv_page_size=kv_page)
+
+    def build_chunked():
+        return Engine(model, params, num_slots=num_slots,
+                      max_len=max_len, pipeline=True, paged=paged,
+                      kv_page_size=kv_page, prefill_chunk=chunk)
+
+    engines = {"disagg": build_pair(), "chunked": build_chunked()}
+
+    def storm_round(eng, seed):
+        """One storm round against either harness (same submit/step/
+        drain surface).  The TPOT being compared is 'wall time per
+        token for an active decoder ON ITS TIER'S HARDWARE':
+
+        - colocated twin: retire-timestamp gaps — each engine step is
+          chunk prefill + decode dispatch sharing one device, and that
+          whole step IS the decoder's inter-token gap.
+        - disagg pair: the two tiers step SERIALLY in this in-process
+          harness, so retire wall-gaps would charge the decode tier
+          for prefill-tier storm work that on a dedicated decode pod
+          runs concurrently.  Instead we time the decode engine's own
+          step() — one retired token per active decoder per step, so
+          its duration is exactly the decode tier's inter-token gap on
+          dedicated hardware."""
+        nonlocal missing
+        r = np.random.default_rng(seed)
+        eng.reset_latency_stats()
+        if isinstance(eng, DisaggPair):
+            eng.prefill.reset_prefix_cache()
+            eng.decode.reset_prefix_cache()
+        elif eng.paged:
+            eng.reset_prefix_cache()
+        gaps = []
+        restore = None
+        if isinstance(eng, DisaggPair):
+            inner = eng.decode.step
+
+            def timed_step():
+                busy = bool(eng.decode._active)
+                t0 = time.perf_counter()
+                out = inner()
+                if busy:     # steps that advance decoders, not no-ops
+                    gaps.append(time.perf_counter() - t0)
+                return out
+
+            eng.decode.step, restore = timed_step, inner
+        try:
+            dec = [eng.submit(r.integers(0, cfg.vocab_size, 4).tolist(),
+                              dec_budget, slo_class="interactive")
+                   for _ in range(n_dec)]
+            for _ in range(6):
+                eng.step()
+            storm = [eng.submit(
+                r.integers(0, cfg.vocab_size, storm_len).tolist(), 2,
+                slo_class="batch") for _ in range(n_storm)]
+            results = {res.rid: res for res in eng.drain()}
+        finally:
+            if restore is not None:
+                eng.decode.step = restore
+        missing += sum(1 for rid in dec + storm if rid not in results)
+        if not isinstance(eng, DisaggPair):
+            events = eng.flight.events()
+            for rid in dec:
+                ts = [e["t"] for e in events
+                      if e.get("rid") == rid and e["ev"] == "retire"]
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        return (float(np.percentile(gaps, 99)) if gaps else 0.0)
+
+    for eng in engines.values():
+        storm_round(eng, seed=123)       # untimed compile round
+    p99s = {name: [] for name in engines}
+    for i in range(rounds):
+        order = list(engines)
+        if i % 2:
+            order.reverse()              # rotation: no fixed adjacency
+        for name in order:
+            p99s[name].append(storm_round(engines[name],
+                                          seed=3000 + i))
+    med = {n: float(np.median(v)) for n, v in p99s.items()}
+    pair = engines["disagg"]
+
+    # Greedy parity: disaggregated outputs == colocated outputs on a
+    # fresh mixed mix (the acceptance criterion, measured not assumed).
+    rng = np.random.default_rng(515)
+    par_reqs = [(rng.integers(0, cfg.vocab_size,
+                              int(rng.integers(2, storm_len))).tolist(),
+                 int(rng.integers(2, 8)))
+                for _ in range(2 * num_slots)]
+    coloc = build_chunked()
+    ref = [coloc.submit(p, m, temperature=0.0, seed=70 + i)
+           for i, (p, m) in enumerate(par_reqs)]
+    ref_map = {res.rid: res for res in coloc.drain()}
+    par_pair = build_pair()
+    got = [par_pair.submit(p, m, temperature=0.0, seed=70 + i)
+           for i, (p, m) in enumerate(par_reqs)]
+    got_map = {res.rid: res for res in par_pair.drain()}
+    matches = sum(1 for a, b in zip(ref, got)
+                  if ref_map[a].tokens == got_map[b].tokens)
+
+    st = pair.stats()
+    mig = st["migration_s"]
+    decode_ledger = st["tiers"]["decode"]["host_dispatches"]
+    return {
+        "tpot_p99_under_storm_disagg": med["disagg"],
+        "tpot_p99_under_storm_chunked": med["chunked"],
+        "tpot_p99_ratio_disagg_vs_chunked": (
+            med["disagg"] / med["chunked"] if med["chunked"] else None),
+        "rounds": rounds, "per_round_p99_s": p99s,
+        "prefill_chunk": chunk, "storm_size": n_storm,
+        "active_decoders": n_dec,
+        "unreached_terminals": missing,
+        "migrations": st["migrations"],
+        "fallbacks": st["fallbacks"],
+        "migration_p50_s": mig.get("p50"),
+        "migration_p99_s": mig.get("p99"),
+        "decode_tier_dispatch_ledger": dict(decode_ledger),
+        "decode_tier_prefill_dispatches": decode_ledger.get(
+            "prefill", 0),
+        "parity_matches": matches,
+        "parity_requests": len(par_reqs),
+        "parity": (matches / len(par_reqs)) if par_reqs else None,
+    }
+
+
 def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
     """Closed-loop serving load generator: goodput under overload.
 
@@ -1283,6 +1446,18 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             deadline_b=deadline_b, max_prompt=max_prompt,
             max_new=max_new)
 
+    disagg_extra = None
+    if _flag(kv, "disagg"):
+        # Disaggregation probe (ISSUE 16): DisaggPair vs the chunked-
+        # colocated engine under the same prefill storm. Same default
+        # chunk choice as the scheduling twin so the two comparisons
+        # share a baseline.
+        chunk = prefill_chunk or min(engine.sched.buckets)
+        disagg_extra = _bench_serve_disagg(
+            model, params, cfg=cfg, num_slots=num_slots,
+            max_len=max_len, chunk=chunk, quick=quick,
+            paged=paged, kv_page=kv_page)
+
     one_x = sweep.get("1x") or next(iter(sweep.values()))
     from nanosandbox_tpu.analysis.shardcheck import provenance
 
@@ -1317,6 +1492,7 @@ def bench_serve(kv: dict, *, quick: bool, on_tpu: bool) -> dict:
             "sweep": sweep,
             "fault": fault_extra,
             "scheduling": sched_extra,
+            "disagg": disagg_extra,
             "watchdog_trips": engine.stats()["watchdog"]["trips"],
             "trace_counts": dict(engine.trace_counts),
         },
@@ -1634,6 +1810,8 @@ def main(argv: list[str]) -> dict:
         kv.setdefault("emit_obs", "1")
     if "--sched" in argv:
         kv.setdefault("sched", "1")
+    if "--disagg" in argv:
+        kv.setdefault("disagg", "1")
     if kv.get("mode") == "decode" and int(kv.get("tp", 1)) > 1 \
             and "jax" not in sys.modules:
         # --tp on a CPU-only install needs virtual host devices, and the

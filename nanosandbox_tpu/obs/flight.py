@@ -19,6 +19,25 @@ that gap (ISSUE 10):
     request re-enters at ``queue`` and STILL reaches exactly one
     terminal (the fuzz pin covers interrupted-and-resumed requests).
 
+    Under disaggregated serving (ISSUE 16) a migrated track spans TWO
+    engines' ledgers (rids are tier-namespaced: ``prefill:7`` /
+    ``decode:3``) and carries the handoff events:
+
+        export   — prefill side: first token sampled, request parked
+                   in migration limbo (slot freed, blocks pinned)
+        migrate  — the chain moved (``blocks``/chain length,
+                   ``bytes``, ``src``/``dst`` engine)
+        adopt    — decode side: chain re-admitted as a prefix hit
+                   through the rung-1 admit program (zero prefill)
+        requeue  — the handoff failed (dst death, backpressure
+                   timeout); the request re-enters colocated on the
+                   source, same rid, same first token
+
+    The exactly-once fuzz extends across the handoff: merged over
+    both tiers (``DisaggPair.merged_flight_events``), each namespaced
+    rid still reaches exactly one terminal, including when
+    ``replica_down`` fires mid-migration.
+
     Each event is one small dict recorded from ALREADY-HOST-RESIDENT
     dispatch-time state (ints/floats the engine holds anyway), so the
     pipelined loop gains no host sync and jaxlint stays clean.  A

@@ -40,6 +40,11 @@ Pieces:
                  replicas by radix-prefix affinity (approximate
                  per-replica digest index, load/brownout/readiness
                  scoring, failover fallback).
+  disagg.py    — DisaggPair: disaggregated prefill/decode serving —
+                 a prefill tier exports {block chain, first token,
+                 seed} into migration limbo and a decode tier adopts
+                 it as a pure prefix hit (zero prefill dispatches),
+                 with wire serialization for the cross-pod form.
   fleet.py     — Fleet: in-process N-replica harness behind the router
                  (namespaced flight ledgers, replica_down failover with
                  exactly-once terminals, aggregated retry hints) — the
@@ -51,6 +56,8 @@ Pieces:
 
 from nanosandbox_tpu.serve.brownout import LEVELS as BROWNOUT_LEVELS
 from nanosandbox_tpu.serve.brownout import BrownoutController
+from nanosandbox_tpu.serve.disagg import (DisaggPair, adopt_from_wire,
+                                          export_to_wire)
 from nanosandbox_tpu.serve.drafters import (ModelDrafter, NGramDrafter,
                                             drafter_from_flag)
 from nanosandbox_tpu.serve.engine import (DEFAULT_PRIORITY,
@@ -79,4 +86,5 @@ __all__ = ["Engine", "Request", "Result", "SlotScheduler",
            "BrownoutController", "BROWNOUT_LEVELS",
            "PRIORITY_BY_CLASS", "DEFAULT_PRIORITY",
            "Fleet", "PrefixAffinityRouter", "RouteDecision",
-           "NoReadyReplicaError"]
+           "NoReadyReplicaError", "DisaggPair", "export_to_wire",
+           "adopt_from_wire"]

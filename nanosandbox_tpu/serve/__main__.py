@@ -116,6 +116,19 @@ def main(argv: list[str] | None = None) -> None:
                          "worst-case max_len row, and prompts sharing "
                          "a resident prefix skip its prefill chunks. "
                          "'off' restores the dense per-slot rows")
+    ap.add_argument("--role", default="both",
+                    choices=("both", "prefill", "decode"),
+                    help="disaggregated serving tier (ISSUE 16): "
+                         "'prefill' pods run chunked prefill waves and "
+                         "export {block chain, first token, seed} as a "
+                         "202 on migrate-flagged /generate; 'decode' "
+                         "pods adopt them via /internal/adopt with zero "
+                         "prefill dispatches (and warm only the admit/"
+                         "decode programs — the strict-subset compile "
+                         "set). 'both' (default) is classic colocated "
+                         "serving. The router frontend discovers the "
+                         "role from /stats and phase-tiers routing "
+                         "when both tiers are ready")
     ap.add_argument("--kv_page_size", type=int, default=16,
                     help="positions per KV block (paged pool); int8 "
                          "pools on real TPUs want >= 32 (sublane "
@@ -324,7 +337,8 @@ def main(argv: list[str] | None = None) -> None:
                     faults=fault_plan,
                     prefill_chunk=args.prefill_chunk or None,
                     preemption=not args.no_preemption,
-                    brownout=args.brownout == "on")
+                    brownout=args.brownout == "on",
+                    role=args.role)
     # Warm the compile set BEFORE binding the port: /healthz going green
     # is the readiness contract the k8s manifest and docs promise
     # ("restore + first compile done"), so no live request may ever eat
@@ -334,7 +348,13 @@ def main(argv: list[str] | None = None) -> None:
     # wave-size compiles for a faster start.
     rungs = (engine.admit_buckets if args.warmup == "full" else [1])
     lo = 1
-    for bucket in engine.sched.buckets:
+    # A decode-tier pod (ISSUE 16) never dispatches a prefill: warming
+    # the prefill grid would WIDEN its compile set and break the
+    # strict-subset contract the disagg shardcheck re-pin asserts, so
+    # the bucket loop is skipped entirely for --role=decode.
+    warm_buckets = ([] if args.role == "decode"
+                    else engine.sched.buckets)
+    for bucket in warm_buckets:
         # Warmup prompt length must actually MAP to this bucket (in
         # (previous rung, bucket]). Prefer leaving room for 2 new
         # tokens — a 1-token request finishes on its prefill-sampled
@@ -374,6 +394,22 @@ def main(argv: list[str] | None = None) -> None:
     # state — the freeze below would otherwise turn the first request
     # mix whose budgets make the chunk policy pick an uncompiled rung
     # into a post-warmup retrace outage.
+    if args.role == "decode":
+        if args.paged != "on":
+            raise SystemExit("--role=decode needs --paged=on: adoption "
+                             "is a paged block-chain operation")
+        # Warm exactly what the decode tier runs — the rung-1 admit
+        # scatter and one decode dispatch — via a throwaway adoption.
+        # The adopted blocks are never written (zero-initialized KV is
+        # fine for a compile) and the chain is flushed so no real
+        # request can prefix-hit it.
+        from nanosandbox_tpu.serve.engine import Request as _Request
+        ad = engine.begin_adopt(
+            _Request(rid=-1, prompt=(0, 0, 0), max_new_tokens=2))
+        if ad is not None:
+            engine.commit_adopt(ad, 0)
+            engine.drain()
+            engine.reset_prefix_cache()
     if args.warmup == "full":
         engine.warm_scan_rungs()
     print(f"[serve] warmup: compiled {engine.trace_counts['prefill']} "
@@ -383,7 +419,9 @@ def main(argv: list[str] | None = None) -> None:
           + (f", {engine.trace_counts.get('verify', 0)} verify "
              f"(spec={args.spec}, k={args.spec_k})"
              if args.spec != "off" else "")
-          + f" (pipeline={'on' if engine.pipeline else 'off'})",
+          + f" (pipeline={'on' if engine.pipeline else 'off'}"
+          + (f", role={args.role}" if args.role != "both" else "")
+          + ")",
           file=sys.stderr, flush=True)
     engine.reset_latency_stats()  # /stats should describe live traffic
     # Post-warmup, ANY compile eats a live request's latency, so the

@@ -191,6 +191,60 @@ class _Chunking:
     done: int = 0
 
 
+@dataclass
+class _Export:
+    """One request parked in MIGRATION LIMBO (ISSUE 16): its prefill
+    completed on this engine — the whole prompt's K/V sits in
+    ``alloc``'s block chain and ``first_tok`` was sampled with the
+    fold_in(seed, true_len) key — but its decode belongs to another
+    tier. The slot was released at export (the row must never decode
+    here), so the record owns exactly {blocks, first token, request}:
+    the migration wire format. Parked in the scheduler's limbo queue,
+    where the deadline sweep sees it like any queued request; shed or
+    aborted from limbo, its blocks free WITHOUT donation (the handoff
+    never completed — the terminal says so, the cache must not claim
+    otherwise... the chain IS fully written, but a shed request's
+    blocks are freed not donated by the ISSUE 16 contract: nothing
+    should warm a cache on traffic the engine refused to serve)."""
+    req: Request
+    alloc: object
+    first_tok: int
+    export_t: float              # wall clock at export (migration p50/p99)
+    submit_t: float              # wall clock at submit (deadline budget)
+    submit_step: int
+
+    # drain_expired applies one predicate to queue items and limbo
+    # records alike — forward the fields it reads.
+    @property
+    def rid(self) -> int:
+        return self.req.rid
+
+    @property
+    def deadline_s(self) -> Optional[float]:
+        return self.req.deadline_s
+
+    @property
+    def priority(self) -> int:
+        return self.req.priority
+
+
+@dataclass
+class _Adoption:
+    """The adopt-side handle between begin_adopt (slot + blocks
+    reserved, nothing written) and commit_adopt (row activated) /
+    abort_adopt (unwound). ``copy`` lists the chain positions whose
+    blocks the caller must fill from the source pool before commit —
+    ``dst_blocks`` are their block ids here."""
+    req: Request
+    slot: int
+    alloc: object
+    copy: List[int]
+
+    @property
+    def dst_blocks(self) -> List[int]:
+        return [self.alloc.table[i] for i in self.copy]
+
+
 class Engine:
     """submit() / step() / drain() continuous-batching engine.
 
@@ -386,7 +440,8 @@ class Engine:
                  prefill_chunk: Optional[int] = None,
                  preemption: bool = True,
                  brownout: bool = False,
-                 tp: int = 1, tp_mesh=None):
+                 tp: int = 1, tp_mesh=None,
+                 role: str = "both"):
         import jax
         import jax.numpy as jnp
 
@@ -625,6 +680,25 @@ class Engine:
         self._admitting: List[Tuple] = []
         self._admitting_span: Optional[int] = None
         self._resumed: Dict[int, _Resume] = {}
+        # Disaggregated serving (ISSUE 16). ``role`` labels the tier
+        # this engine plays ("prefill" runs chunked waves and exports,
+        # "decode" adopts migrated chains, "both" is the classic
+        # colocated engine — the role is telemetry + fleet routing
+        # metadata, never a capability gate: a prefill engine that must
+        # fall back to colocated decode, e.g. when its decode tier
+        # died, still can). ``_migrate_rids`` marks requests submitted
+        # with migrate=True: they allocate prompt-only block footprints
+        # and EXPORT at the first-token readback instead of going
+        # active. ``migrated``/``adopted`` count handoffs out of / into
+        # this engine.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got "
+                f"{role!r}")
+        self.role = role
+        self._migrate_rids: set = set()
+        self.migrated = 0
+        self.adopted = 0
         if default_deadline_s is not None and default_deadline_s <= 0:
             raise ValueError(f"default_deadline_s must be > 0, got "
                              f"{default_deadline_s}")
@@ -710,6 +784,23 @@ class Engine:
             "serve_tp_degree",
             "Tensor-parallel degree of the decode engine (model-axis "
             "shards; 1 = single chip).")
+        # Disaggregated-serving posture (ISSUE 16): which tier this
+        # engine serves (1-hot), plus its sides of the migration flow.
+        self._g_role = m.gauge(
+            "serve_engine_role",
+            "Serving tier of this engine (1 = active role).",
+            labelnames=("role",))
+        self._g_limbo = m.gauge(
+            "serve_migration_limbo_depth",
+            "Exports parked awaiting adoption by the decode tier.")
+        self._c_migrated = m.counter(
+            "serve_migrated_out_total",
+            "Requests this engine prefilled and handed to another "
+            "tier (terminal accounting moves with them).")
+        self._c_adopted = m.counter(
+            "serve_adopted_in_total",
+            "Migrated requests this engine re-admitted as pure prefix "
+            "hits (zero prefill dispatches).")
         # Paged-pool + prefix-cache signal (ISSUE 9): block states
         # partition the pool, the hit/miss token counters are the
         # prefix_hit_rate numerator/denominator, and TTFT re-observes
@@ -1185,6 +1276,10 @@ class Engine:
         self._g_impl.labels(impl=self.decode_impl).set(1.0)
         self._g_kv.labels(kv_dtype=self.kv_dtype).set(1.0)
         self._g_tp.set(float(self.tp))
+        self._g_role.labels(role=self.role).set(1.0)
+        self._g_limbo.set(self.sched.limbo)
+        self._c_migrated._set_total(self.migrated)
+        self._c_adopted._set_total(self.adopted)
         if self.block_pool is not None:
             ps = self.block_pool.stats()
             for state in ("free", "live", "cached"):
@@ -1209,7 +1304,8 @@ class Engine:
                seed: int = 0, eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                slo_class: str = "default",
-               priority: Optional[int] = None) -> int:
+               priority: Optional[int] = None,
+               migrate: bool = False) -> int:
         """Queue one request; returns its id. Fixed-shape admission rules
         are enforced here so a bad request fails at submit, not as a
         mid-flight surprise — every reject leaves a terminal ``reject``
@@ -1220,7 +1316,16 @@ class Engine:
         the preemption policy. Under an active brownout shed floor a
         below-floor submission is accepted but immediately SHED (a
         terminal 'shed' Result — 429 + Retry-After upstream, never a
-        silent queue-rot)."""
+        silent queue-rot).
+
+        ``migrate=True`` (ISSUE 16, paged engines only) marks the
+        request for DISAGGREGATED handoff: this engine runs only its
+        prefill (allocating the prompt's blocks, no generation
+        budget), then parks the block chain + sampled first token in
+        migration limbo for a decode tier to adopt — see pop_export()
+        / Engine.begin_adopt(). The request's terminal Result comes
+        from the ADOPTING engine (or from here, if the first token
+        already finishes it or the export is shed/aborted)."""
         prompt = tuple(int(t) for t in prompt)
         plen = len(prompt)
         if self.failed:
@@ -1295,6 +1400,12 @@ class Engine:
                     f"request needs {need} KV blocks but the pool holds "
                     f"{self.kv_pool_blocks}; raise kv_pool_blocks or "
                     "shorten the request", prompt_len=plen)
+        if migrate and not self.paged:
+            self._reject(
+                "migrate_unpaged",
+                "migrate=True needs a paged engine: the block chain IS "
+                "the migration wire format (dense per-slot caches have "
+                "nothing portable to hand off)", prompt_len=plen)
         if priority is None:
             priority = PRIORITY_BY_CLASS.get(slo_class, DEFAULT_PRIORITY)
         else:
@@ -1342,6 +1453,10 @@ class Engine:
                 Result(rid=rid, prompt=prompt, tokens=[],
                        finish_reason="length"))
             return rid
+        if migrate and max_new_tokens > 1:
+            # max_new <= 1 finishes at the prefill readback — nothing
+            # left to migrate; those ride the colocated path untouched.
+            self._migrate_rids.add(rid)
         sid = self.tracer.begin("queued", cat="request", rid=rid,
                                 args={"prompt_len": plen,
                                       "max_new": max_new_tokens})
@@ -1352,7 +1467,12 @@ class Engine:
         return rid
 
     def has_work(self) -> bool:
+        # Limbo counts: a parked export owes its client a terminal and
+        # holds blocks — idle-with-limbo is not idle. Callers that
+        # drain() a migrate-submitting engine must pump its exports
+        # (DisaggPair.drain does) or carry deadlines that shed them.
         return bool(self._active or self.sched.queued or self._chunking
+                    or self.sched.limbo
                     or self._pending_results or self._inflight is not None)
 
     def step(self) -> List[Result]:
@@ -1487,19 +1607,49 @@ class Engine:
         attainment. Requests without deadlines never deadline-shed
         (brownout can still shed them). Cheap when the queue carries no
         deadlines and no brownout is active — one attribute scan, no
-        allocation (scheduler.drain_expired)."""
-        if not self.sched.queued:
+        allocation (scheduler.drain_expired).
+
+        The sweep also covers MIGRATION LIMBO (the ISSUE 16 fix):
+        a request parked awaiting decode-tier adoption carries the same
+        unserved deadline as a queued one — a stalled decode tier must
+        shed it with a terminal ``shed``, its blocks released WITHOUT
+        donation, not leak it forever. Limbo records shed on deadline
+        only (never the brownout floor: their prefill is already paid —
+        shedding it saves nothing)."""
+        if not (self.sched.queued or self.sched.limbo):
             return
         now = time.monotonic()
         meta = self._submit_meta
         floor = self.brownout_min_priority
 
-        def expired(req) -> bool:
-            return ((req.deadline_s is not None
-                     and now - meta[req.rid][1] > req.deadline_s)
-                    or (floor is not None and req.priority < floor))
+        def expired(item) -> bool:
+            if isinstance(item, _Export):
+                return (item.deadline_s is not None
+                        and now - item.submit_t > item.deadline_s)
+            return ((item.deadline_s is not None
+                     and now - meta[item.rid][1] > item.deadline_s)
+                    or (floor is not None and item.priority < floor))
 
-        for req in self.sched.drain_expired(expired):
+        for item in self.sched.drain_expired(expired):
+            if isinstance(item, _Export):
+                self.shed += 1
+                # Blocks freed, never donated (the ISSUE 16 contract):
+                # a shed must not warm the cache on refused traffic.
+                self.block_pool.release(item.alloc, donate=False)
+                waited = now - item.submit_t
+                self.flight.record(
+                    "shed", rid=item.rid, step=self.steps,
+                    reason="deadline", limbo=True,
+                    waited_s=round(waited, 6),
+                    deadline_s=item.deadline_s,
+                    slo_class=item.req.slo_class)
+                self.slo.record_shed(item.req.slo_class)
+                finished.append(Result(rid=item.rid,
+                                       prompt=item.req.prompt,
+                                       tokens=[],
+                                       finish_reason="shed"))
+                continue
+            req = item
             sub_step, sub_t, sid = meta.pop(req.rid)
             self.shed += 1
             self.tracer.end(sid, {"shed": True,
@@ -1713,6 +1863,12 @@ class Engine:
             "admitted": self.admitted,
             "completed": self.completed,
             "shed": self.shed,
+            # Disaggregated posture (ISSUE 16): tier role plus both
+            # sides of the migration flow this engine has seen.
+            "role": self.role,
+            "limbo": self.sched.limbo,
+            "migrated": self.migrated,
+            "adopted": self.adopted,
             "rejected": dict(self.rejected),
             "default_deadline_s": self.default_deadline_s,
             # Scheduling endgame (ISSUE 13): preemption/chunk/brownout
@@ -2018,8 +2174,29 @@ class Engine:
             cls["queued"] += 1
             pr = cls["priorities"]
             pr[item.priority] = pr.get(item.priority, 0) + 1
+        # The migration limbo queue (ISSUE 16): exports prefilled here,
+        # awaiting adoption by the decode tier. Same deadline fields as
+        # the admission queue — limbo is swept by the same shed pass.
+        limbo = []
+        for exp in self.sched.limbo_items():
+            waited = round(now - exp.submit_t, 6)
+            limbo.append({
+                "rid": exp.rid, "prompt_len": len(exp.req.prompt),
+                "chain_blocks": len(exp.alloc.table),
+                "hit_blocks": exp.alloc.n_hit,
+                "slo_class": exp.req.slo_class,
+                "priority": exp.priority,
+                "deadline_s": exp.deadline_s,
+                "waited_s": waited,
+                "limbo_s": round(now - exp.export_t, 6),
+                "expired": bool(exp.deadline_s is not None
+                                and waited > exp.deadline_s),
+            })
         out = {"queued": len(queued), "queue": queued,
                "queue_by_class": by_class,
+               "role": self.role,
+               "limbo": len(limbo), "limbo_queue": limbo,
+               "migrated": self.migrated, "adopted": self.adopted,
                "free_slots": self.sched.free_slots,
                "active": len(self._active),
                "prefill_buckets": list(self.sched.buckets),
@@ -2069,12 +2246,18 @@ class Engine:
             self.flight.record("fault", rid=req.rid, step=self.steps,
                                site="alloc_fail")
             return None
-        a = self.block_pool.admit(req.prompt, req.max_new_tokens)
+        # A migrate-flagged request reserves its PROMPT chain only: the
+        # generation budget belongs to the adopting decode tier, and
+        # double-reserving it here is exactly the pool pressure
+        # disaggregation exists to remove from the prefill tier.
+        max_new = (0 if req.rid in self._migrate_rids
+                   else req.max_new_tokens)
+        a = self.block_pool.admit(req.prompt, max_new)
         if a is None:
             self.flight.record(
                 "block_stall", rid=req.rid, step=self.steps,
                 need=self.block_pool.blocks_needed(
-                    len(req.prompt), req.max_new_tokens),
+                    len(req.prompt), max_new),
                 free=self.block_pool.free_blocks)
             return None
         self.flight.record("block_reserve", rid=req.rid,
@@ -2287,6 +2470,42 @@ class Engine:
             prefix="hit" if hit_toks else "miss",
             hit_tokens=hit_toks,
             suffix_tokens=len(req.prompt) - hit_toks)
+        if req.rid in self._migrate_rids:
+            self._migrate_rids.discard(req.rid)
+            finishes_here = (
+                poisoned
+                or (req.eos_id is not None and first_tok == req.eos_id)
+                or req.max_new_tokens <= 1)
+            if not finishes_here and alloc is not None:
+                import jax.numpy as jnp
+
+                # EXPORT (ISSUE 16): the prompt's K/V is fully written —
+                # including the partial tail block — and the first token
+                # is in hand; everything a decode tier needs. Release
+                # the slot NOW, host and device (commit runs before this
+                # step's decode dispatch, so the row never decodes here
+                # and the chain is never written again — the bit-
+                # identity the adoption copy depends on), and park the
+                # chain + token in migration limbo. The request's
+                # terminal belongs to whoever adopts (or to the deadline
+                # sweep / abort path if nobody does). A poisoned,
+                # instantly-finished, or alloc-less first token falls
+                # through to the colocated path below instead: migration
+                # is an optimization, never a correctness fork.
+                self.sched.release(slot)
+                self._state = self._release(self._state,
+                                            jnp.asarray(slot, jnp.int32))
+                self.host_dispatches["release"] += 1
+                exp = _Export(req=req, alloc=alloc, first_tok=first_tok,
+                              export_t=now, submit_t=sub_t,
+                              submit_step=sub_step)
+                self.sched.park_limbo(exp)
+                self.flight.record(
+                    "export", rid=req.rid, step=self.steps,
+                    chain_blocks=len(alloc.table),
+                    hit_blocks=alloc.n_hit,
+                    prompt_len=len(req.prompt))
+                return False
         gen_sid = self.tracer.begin(
             "generate", cat="request", rid=req.rid,
             args={"slot": slot, "bucket": bucket})
@@ -3187,6 +3406,24 @@ class Engine:
             requeue.append((entry.req,
                             len(base.tokens) if base else 0, None))
         self._chunking = []
+        while True:
+            # Migration limbo: the export's chain is fully written and
+            # its row already released — donate it back (clean by the
+            # same copy-on-write argument as actives; under flush_cache
+            # the reset below evicts it anyway), restore the migrate
+            # intent, and requeue. Re-prefill is a prefix hit over the
+            # just-donated chain and resamples the SAME first token
+            # (fold_in(seed, true_len)), so the re-export is token-
+            # identical to the one this recovery discarded.
+            exp = self.sched.pop_limbo()
+            if exp is None:
+                break
+            self.block_pool.release(exp.alloc)
+            self._migrate_rids.add(exp.req.rid)
+            base = self._resumed.get(exp.req.rid)
+            requeue.append((exp.req,
+                            len(base.tokens) if base else 0,
+                            exp.submit_t))
         if flush_cache:
             from nanosandbox_tpu.models.gpt import (init_cache,
                                                     init_paged_cache)
@@ -3273,8 +3510,18 @@ class Engine:
         self._active = {}
         self._admitting = []
         self._chunking = []
-        for req in self.sched.drain_expired(lambda item: True):
-            victims.append((req, None, None, [], True))
+        self._migrate_rids.clear()
+        for item in self.sched.drain_expired(lambda item: True):
+            if isinstance(item, _Export):
+                # Migration limbo: blocks held, no slot. The handoff
+                # never completed — free without donation and salvage
+                # the sampled first token into the terminal, like any
+                # in-flight victim's partial tokens.
+                self.block_pool.release(item.alloc, donate=False)
+                victims.append((item.req, None, item.alloc,
+                                [item.first_tok], True))
+                continue
+            victims.append((item, None, None, [], True))
         self._state = self._fresh_slot_state()
         for req, slot, alloc, toks, queued in victims:
             meta = self._submit_meta.pop(req.rid, None)
@@ -3292,6 +3539,234 @@ class Engine:
         self.flight.record("engine_failed", step=self.steps, cause=cause,
                            aborted=len(victims))
         return results
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode (ISSUE 16). Export side: a migrate-
+    # flagged request parks (block chain + first token) in limbo at its
+    # first-token readback; the pump pops it, moves the blocks, and
+    # either completes the export (adopted elsewhere) or requeues it
+    # (colocated fallback — the exactly-once failure path). Adopt side:
+    # begin/commit/abort adopt re-admits a migrated chain as a pure
+    # prefix hit through the rung-1 admit program — ZERO prefill
+    # dispatches, which is the whole point: the decode tier's compile
+    # set stays {decode rungs, admit, release}, a strict subset of the
+    # colocated engine's (jits are lazy; a program never dispatched is
+    # never compiled), and its TPOT never pays for anyone's prompt.
+    # ------------------------------------------------------------------
+    def pop_export(self) -> Optional[_Export]:
+        """Claim the oldest limbo-parked export for transfer (None when
+        empty). The caller now owns the record: it must end in exactly
+        one of complete_export (handoff succeeded), requeue_export
+        (fallback to colocated here), or repark_export (transient
+        backpressure — try again next pump)."""
+        return self.sched.pop_limbo()
+
+    def repark_export(self, exp: _Export) -> None:
+        """Return an un-transferred export to the HEAD of limbo (the
+        adopting tier had no slot/blocks this pump); the deadline sweep
+        keeps watching it."""
+        self.sched.park_limbo_front(exp)
+
+    def complete_export(self, exp: _Export, *, dst: str = "",
+                        blocks_copied: int = 0, bytes_moved: int = 0,
+                        migrate_s: float = 0.0) -> None:
+        """The handoff landed: the adopting engine committed the row.
+        Release the chain WITH donation — it is fully written and
+        clean, and keeping it warm in this tier's radix trie is what
+        makes a later failover restitch (prompt + salvaged tokens) a
+        prefix HIT here instead of a full re-prefill — and leave the
+        terminal accounting to the adopter. Records the exactly-once
+        ``migrate`` flight event (chain length, transferred bytes,
+        src/dst) on THIS engine: the source owns the handoff story."""
+        self.migrated += 1
+        self.completed += 1
+        self._c_completed.labels(reason="migrated").inc()
+        self.block_pool.release(exp.alloc)
+        self.flight.record(
+            "migrate", rid=exp.req.rid, step=self.steps,
+            dst=dst, chain_blocks=len(exp.alloc.table),
+            hit_blocks=exp.alloc.n_hit, copied_blocks=blocks_copied,
+            bytes=bytes_moved, migrate_s=round(migrate_s, 6),
+            limbo_s=round(time.monotonic() - exp.export_t, 6))
+
+    def requeue_export(self, exp: _Export, *, migrate: bool = False) -> None:
+        """Fallback: no decode tier can adopt (tier death, permanent
+        backpressure) — put the request back through THIS engine's
+        admission, colocated by default. Blocks release WITH donation
+        (the chain is clean and fully written), so the re-prefill is a
+        pure prefix hit that resamples the SAME first token
+        (fold_in(seed, true_len)) — the terminal Result is token-
+        identical to the migration that never happened, under the
+        request's ORIGINAL rid and deadline budget: exactly-once by
+        construction, no pair-level dedup needed."""
+        self.block_pool.release(exp.alloc)
+        if migrate:
+            self._migrate_rids.add(exp.req.rid)
+        sid = self.tracer.begin("queued", cat="request", rid=exp.req.rid,
+                                args={"requeued_export": True})
+        self._submit_meta[exp.req.rid] = (exp.submit_step,
+                                          exp.submit_t, sid)
+        self.sched.requeue_front([exp.req])
+        self.flight.record("requeue", rid=exp.req.rid, step=self.steps,
+                           cause="export_fallback", tokens_done=0)
+
+    def begin_adopt(self, req: Request, *,
+                    max_new_tokens: Optional[int] = None
+                    ) -> Optional[_Adoption]:
+        """Phase 1 of adopting a migrated request: claim a slot and the
+        FULL block footprint (prompt chain + generation budget —
+        paged.adopt_chain). Returns None when this engine cannot take
+        it right now (no free slot, pool shortfall, quarantine) — the
+        adoption-backpressure signal; the caller re-parks the export
+        and retries next pump. On success the handle's ``copy``/
+        ``dst_blocks`` name the blocks to fill via write_pool_blocks
+        before commit_adopt; abort_adopt unwinds a transfer that died
+        mid-flight. The request is re-keyed into THIS engine's rid
+        space (the pair/frontend owns the cross-engine mapping)."""
+        if self.failed:
+            raise EngineFailedError(
+                "engine permanently failed; cannot adopt")
+        if not self.paged:
+            raise ValueError("adoption needs a paged engine: the block "
+                             "chain is the migration wire format")
+        max_new = (req.max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if len(req.prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"adopted prompt ({len(req.prompt)}) + max_new "
+                f"({max_new}) exceeds max_len {self.max_len}")
+        if self.quarantined or not self.sched.free_slots:
+            return None
+        got = self.block_pool.adopt_chain(req.prompt, max_new)
+        if got is None:
+            self.flight.record(
+                "block_stall", rid=-1, step=self.steps,
+                need=self.block_pool.blocks_needed(len(req.prompt),
+                                                   max_new),
+                free=self.block_pool.free_blocks, adopt=True)
+            return None
+        alloc, copy = got
+        req = replace(req, rid=next(self._rid), max_new_tokens=max_new)
+        return _Adoption(req=req, slot=self.sched.take_slot(),
+                         alloc=alloc, copy=copy)
+
+    def abort_adopt(self, ad: _Adoption) -> None:
+        """Unwind a begun adoption whose transfer failed (source died
+        mid-copy, injected fault): slot back, blocks freed WITHOUT
+        donation — the chain may be partially copied and a half-written
+        chain must never serve a prefix hit. No terminal here: the
+        request still lives on the SOURCE side (its export record),
+        which resolves it exactly once via requeue/complete/shed."""
+        self.sched.release(ad.slot)
+        self.block_pool.release(ad.alloc, donate=False)
+
+    def commit_adopt(self, ad: _Adoption, first_tok: int, *,
+                     submit_t: Optional[float] = None,
+                     src: str = "") -> Tuple[int, Optional[Result]]:
+        """Phase 2: activate the adopted row. One rung-1 ``admit``
+        scatter stages the block table, pos = true_len = len(prompt),
+        the migrated first token, and the ORIGINAL seed — decode
+        continues with fold_in(seed, pos + 1) keys, exactly the stream
+        the source's colocated decode would have produced, so greedy
+        outputs are token-identical to never having migrated (pinned by
+        test). NO prefill dispatch, no readback: admission here is a
+        pure prefix hit by construction. Returns (rid-on-this-engine,
+        immediately-finished Result or None)."""
+        req = ad.req
+        if not 0 <= int(first_tok) < self.cfg.vocab_size:
+            # A poisoned/corrupt wire token must not be scattered: the
+            # caller unwinds (abort_adopt) and the source falls back.
+            raise ValueError(
+                f"migrated first token {first_tok} outside [0, "
+                f"vocab_size={self.cfg.vocab_size})")
+        now = time.monotonic()
+        nb = self.slot_blocks
+        meta = np.zeros((1, self._meta_width), np.int32)
+        meta[0, :nb] = self.kv_pool_blocks
+        meta[0, :len(ad.alloc.table)] = ad.alloc.table
+        meta[0, nb] = ad.slot
+        meta[0, nb + 1] = len(req.prompt)
+        meta[0, nb + 2] = req.top_k
+        meta[0, nb + 3] = req.seed
+        meta[0, nb + 4] = ad.alloc.n_hit * self.kv_page_size
+        fmeta = np.array([[req.temperature, req.top_p]], np.float32)
+        toks = np.array([int(first_tok)], np.int32)
+        self._state = self._admit(self._state, self._stage(toks),
+                                  self._stage(meta), self._stage(fmeta))
+        self.host_dispatches["admit"] += 1
+        self.admitted += 1
+        self.adopted += 1
+        self._c_submitted.inc()
+        gen_sid = self.tracer.begin(
+            "generate", cat="request", rid=req.rid,
+            args={"slot": ad.slot, "adopted": True})
+        st = _Active(req=req, slot=ad.slot, tokens=[int(first_tok)],
+                     first_token_t=now,
+                     submit_t=submit_t if submit_t is not None else now,
+                     last_t=now, span=gen_sid, alloc=ad.alloc)
+        self._active[ad.slot] = st
+        self.flight.record(
+            "adopt", rid=req.rid, step=self.steps, slot=ad.slot,
+            src=src, chain_blocks=len(ad.alloc.table),
+            hit_blocks=ad.alloc.n_hit, copied_blocks=len(ad.copy),
+            prompt_len=len(req.prompt))
+        return req.rid, self._maybe_finish(st)
+
+    def read_pool_blocks(self, block_ids: Sequence[int]) -> List:
+        """Gather whole KV-pool blocks by id, one host array per pool
+        leaf in jax.tree flatten order — the migration wire payload
+        (quantized pools ride as-is: int8/int4 codes + their scales are
+        just more leaves, so a migration never dequantizes). A host
+        sync by design: migration is a cold-path transfer the pump runs
+        BETWEEN steps, never a per-token cost — it lives outside the
+        engine's guarded compile set and its host-sync ledger."""
+        import jax
+        idx = np.asarray(list(block_ids), np.int32)
+        return [np.asarray(leaf)[idx]
+                for leaf in jax.tree_util.tree_leaves(self._pool)]
+
+    def write_pool_blocks(self, block_ids: Sequence[int],
+                          values: Sequence) -> int:
+        """Scatter whole blocks into this pool by id — the adopt-side
+        twin of read_pool_blocks. Updates are padded to the fixed
+        slot_blocks rung with the out-of-range drop sentinel, so every
+        chain length rides ONE implicit program per leaf instead of
+        minting a shape per migration (the fixed-shape discipline,
+        applied to the cold path too). Returns payload bytes written
+        (real rows only — padding is free)."""
+        import jax
+        n = len(block_ids)
+        if n == 0:
+            return 0
+        if n > self.slot_blocks:
+            raise ValueError(
+                f"{n} blocks exceed the per-request maximum "
+                f"{self.slot_blocks}")
+        idx = np.full((self.slot_blocks,), self.kv_pool_blocks, np.int32)
+        idx[:n] = np.asarray(list(block_ids), np.int32)
+        idx_dev = self._stage(idx)
+        leaves, treedef = jax.tree_util.tree_flatten(self._pool)
+        if len(values) != len(leaves):
+            raise ValueError(
+                f"payload has {len(values)} leaves, pool has "
+                f"{len(leaves)}")
+        out = []
+        nbytes = 0
+        for leaf, vals in zip(leaves, values):
+            v = np.asarray(vals)
+            if v.shape[0] < n or v.shape[1:] != leaf.shape[1:] \
+                    or v.dtype != leaf.dtype:
+                raise ValueError(
+                    f"payload leaf {v.shape}/{v.dtype} does not match "
+                    f"pool leaf {leaf.shape}/{leaf.dtype}")
+            nbytes += v[:n].nbytes
+            padded = np.zeros((self.slot_blocks,) + tuple(leaf.shape[1:]),
+                              v.dtype)
+            padded[:n] = v[:n]
+            out.append(leaf.at[idx_dev].set(self._stage(padded),
+                                            mode="drop"))
+        self._pool = jax.tree_util.tree_unflatten(treedef, out)
+        return nbytes
 
     def retry_after_s(self, slo_class: Optional[str] = None,
                       priority: Optional[int] = None) -> float:

@@ -49,7 +49,16 @@ Sites (where the engine consults the plan — see Engine for the hooks):
                   router's failure path is exercised end to end:
                   health-out within one interval, victims re-routed to
                   surviving replicas with exactly-once fleet terminals
-                  and token-identical greedy resumes.
+                  and token-identical greedy resumes.  Disaggregated
+                  serving (ISSUE 16) consults the same site from
+                  ``DisaggPair._pump`` — once per migration, INSIDE
+                  the handoff window (destination blocks reserved via
+                  begin_adopt, nothing committed), the hardest
+                  exactly-once case: the adoption aborts (released
+                  WITHOUT donation), the decode tier is marked failed,
+                  and the export either requeues colocated on the
+                  prefill engine (same rid, same first token) or
+                  surfaces terminal 'failed' with fallback off.
 
 Plans are enabled only by the explicit ``Engine(faults=...)`` /
 ``bench.py --faults=...`` hook: with no plan attached every site check

@@ -50,9 +50,11 @@ import json
 import math
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
@@ -73,6 +75,18 @@ class _Pending:
         self.error: Optional[Exception] = None
 
 
+@dataclass
+class ExportPayload:
+    """What a migrate-flagged /generate waiter receives INSTEAD of a
+    Result when its request exports (ISSUE 16): the serialized handoff
+    (disagg.export_to_wire). The HTTP layer answers 202 with it; the
+    router frontend carries it to a decode-tier replica's
+    /internal/adopt and then resolves the handoff exactly-once via
+    /internal/export_done."""
+    rid: int
+    wire: dict
+
+
 class EngineLoop(threading.Thread):
     """Background thread that owns the Engine: drains the submission
     inbox, steps while any request is in flight, sleeps otherwise.
@@ -83,7 +97,8 @@ class EngineLoop(threading.Thread):
     finish, new submissions get DrainingError (503 upstream), and
     readiness goes red so the fleet stops routing here."""
 
-    def __init__(self, engine, supervisor=None):
+    def __init__(self, engine, supervisor=None,
+                 export_timeout_s: float = 60.0):
         super().__init__(daemon=True, name="serve-engine-loop")
         self.engine = engine
         self.supervisor = supervisor
@@ -91,6 +106,13 @@ class EngineLoop(threading.Thread):
         self._cond = threading.Condition()
         self._inbox: list[_Pending] = []
         self._by_rid: dict[int, _Pending] = {}
+        self._calls: list[tuple[Callable, _Pending]] = []
+        # rid -> (export record, monotonic stamp): handoffs answered 202
+        # and awaiting the frontend's /internal/export_done callback.
+        # Reclaimed (requeued colocated) after export_timeout_s so a
+        # crashed frontend can't strand a request in limbo forever.
+        self._exports: dict[int, tuple] = {}
+        self.export_timeout_s = float(export_timeout_s)
         self._stopping = False
         self.draining = False
         # Set when the loop dies on an engine error: /healthz keys off it
@@ -123,6 +145,77 @@ class EngineLoop(threading.Thread):
         if p.error is not None:
             raise p.error
         return p.result
+
+    def call(self, fn: Callable, timeout: Optional[float] = 30.0):
+        """Run ``fn(engine)`` ON the loop thread and return its result.
+
+        The engine is single-threaded by contract — handler threads must
+        never touch it directly. This is the marshal the disagg
+        endpoints (/internal/adopt, /internal/export_done) use to mutate
+        engine state between steps."""
+        p = _Pending({})
+        with self._cond:
+            if self.dead is not None:
+                raise RuntimeError(f"engine loop died: {self.dead}")
+            self._calls.append((fn, p))
+            self._cond.notify()
+        if not p.done.wait(timeout):
+            raise TimeoutError("engine loop call timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def adopt(self, wire: dict, *, src: str = ""):
+        """Adopt one migrated export on this (decode-tier) engine.
+
+        Returns a waiter handle (_Pending) for the adopted request's
+        terminal Result, the Result itself when adoption finishes the
+        request immediately (max_new_tokens == 1), or None on adoption
+        backpressure (no free slot / no free blocks) — the frontend
+        should try another decode replica or fall back."""
+        from nanosandbox_tpu.serve.disagg import adopt_from_wire
+
+        def fn(eng):
+            got = adopt_from_wire(eng, wire, src=src)
+            if got is None:
+                return None
+            rid, done = got
+            if done is not None:
+                return done
+            p = _Pending({})
+            self._by_rid[rid] = p  # loop thread: no lock needed
+            return p
+
+        return self.call(fn)
+
+    def export_done(self, rid: int, ok: bool, *, dst: str = "",
+                    copied_blocks: int = 0, bytes_moved: int = 0):
+        """Resolve one proxied handoff (the frontend's callback after
+        the adopt leg). ok=True completes the migration (blocks release
+        WITH donation — the warm chain keeps serving prefix hits).
+        ok=False requeues the request COLOCATED here under its original
+        rid and returns a fresh waiter handle the frontend blocks on for
+        the terminal Result. Returns True (completed), a _Pending
+        (fallback waiter), or None if the export is unknown — already
+        reclaimed by timeout, or never existed."""
+
+        def fn(eng):
+            entry = self._exports.pop(rid, None)
+            if entry is None:
+                return None
+            exp, _t0 = entry
+            if ok:
+                eng.complete_export(
+                    exp, dst=dst, blocks_copied=copied_blocks,
+                    bytes_moved=bytes_moved,
+                    migrate_s=time.monotonic() - exp.export_t)
+                return True
+            p = _Pending({})
+            self._by_rid[rid] = p
+            eng.requeue_export(exp)
+            return p
+
+        return self.call(fn)
 
     def stop(self) -> None:
         with self._cond:
@@ -202,12 +295,25 @@ class EngineLoop(threading.Thread):
         while True:
             with self._cond:
                 while (not self._stopping and not self._inbox
+                       and not self._calls
                        and not self.engine.has_work()):
-                    self._cond.wait()
+                    # With pending 202'd handoffs, wake on a timer so a
+                    # crashed frontend's exports get reclaimed even if
+                    # no new traffic arrives to tick the loop.
+                    if not self._cond.wait(
+                            1.0 if self._exports else None):
+                        break
                 if self._stopping:
                     self._fail_all(RuntimeError("server shutting down"))
                     return
                 inbox, self._inbox = self._inbox, []
+                calls, self._calls = self._calls, []
+            for fn, p in calls:
+                try:
+                    p.result = fn(self.engine)
+                except Exception as e:
+                    p.error = e
+                p.done.set()
             for p in inbox:
                 try:
                     rid = self.engine.submit(**p.kwargs)
@@ -233,6 +339,45 @@ class EngineLoop(threading.Thread):
                 if p is not None:
                     p.result = res
                     p.done.set()
+            self._pump_exports()
+
+    def _pump_exports(self) -> None:
+        """Drain the engine's migration limbo: requests that exported
+        this step. A migrate-flagged waiter gets an ExportPayload (the
+        HTTP layer answers 202 and the frontend carries the chain to
+        the decode tier); an export with NO waiter — direct submit, or
+        a client that already timed out — can't be proxied by anyone,
+        so it falls straight back to colocated decode here."""
+        eng = self.engine
+        pop = getattr(eng, "pop_export", None)
+        if pop is None:
+            return
+        now = time.monotonic()
+        for rid, (exp, t0) in list(self._exports.items()):
+            if now - t0 > self.export_timeout_s:
+                # Frontend never called back: reclaim. The client's 202
+                # is stale, but the request still resolves exactly once
+                # (colocated, under its original rid).
+                del self._exports[rid]
+                eng.requeue_export(exp)
+        while True:
+            exp = pop()
+            if exp is None:
+                return
+            p = self._by_rid.pop(exp.req.rid, None)
+            if p is None:
+                eng.requeue_export(exp)
+                continue
+            try:
+                from nanosandbox_tpu.serve.disagg import export_to_wire
+                wire = export_to_wire(eng, exp)
+            except Exception:
+                self._by_rid[exp.req.rid] = p
+                eng.requeue_export(exp)
+                continue
+            self._exports[exp.req.rid] = (exp, now)
+            p.result = ExportPayload(rid=exp.req.rid, wire=wire)
+            p.done.set()
 
     def _fail_all(self, err: Exception) -> None:
         """Signal every waiter — queued AND mid-generation (call with
@@ -266,7 +411,25 @@ def make_server(host: str, port: int, loop: EngineLoop,
                      that class; a request lost to permanent engine
                      failure returns 503 with its partial tokens. Every
                      response's status lands in the flight recorder as
-                     an ``http`` event.
+                     an ``http`` event. With ``"migrate": true`` the
+                     request prefills here and answers **202** with the
+                     serialized handoff ({"id", "migrate": true,
+                     "export": <wire>}) instead of decoding — the
+                     disaggregated path (ISSUE 16).
+    POST /internal/adopt  body = the 202 ``export`` payload -> adopt the
+                     migrated chain on THIS (decode-tier) engine and
+                     block until the request finishes; response is
+                     /generate-shaped plus ``adopted: true``. 503 +
+                     ``retryable: true`` on adoption backpressure (try
+                     another decode replica), 400 on an incompatible
+                     payload (fall back colocated at the source).
+    POST /internal/export_done  {"rid", "ok", "dst"?, "copied_blocks"?,
+                     "bytes"?} -> resolve a 202'd handoff at the source:
+                     ok=true completes the migration (chain donated to
+                     the prefix cache); ok=false requeues COLOCATED and
+                     blocks until the fallback finishes, answering with
+                     the final /generate-shaped body. 410 once the
+                     handoff was reclaimed by timeout.
     POST /drain     begin graceful drain (idempotent): in-flight work
                      finishes, new /generate gets 503 + Retry-After,
                      readiness goes red. The k8s preStop hook calls
@@ -372,6 +535,55 @@ def make_server(host: str, port: int, loop: EngineLoop,
             headers = ({"Retry-After": _retry_after(slo_class)}
                        if retry_after else None)
             self._json(code, obj, headers=headers)
+
+        def _respond_result(self, res, slo_class=None,
+                            extra: Optional[dict] = None) -> None:
+            """Terminal Result -> HTTP status + body (shared by
+            /generate, /internal/adopt and the /internal/export_done
+            fallback leg, so every path a request can resolve through
+            speaks the same shapes)."""
+            if res.finish_reason == "shed":
+                # Deadline expired in the queue (or the brownout ladder
+                # is shedding this class): the engine is healthy, THIS
+                # request lost — 429, try again when the queue has
+                # cleared (Retry-After says when, scaled by the
+                # requester's class). tokens are non-empty only for a
+                # recovery/preemption-requeued victim shed awaiting
+                # re-admission (the salvaged pre-fault output).
+                cls = slo_class or "default"
+                body = {"error": "shed: deadline expired in the "
+                                 "queue (or brownout shed)",
+                        "id": res.rid, "tokens": res.tokens,
+                        "finish_reason": "shed", "slo_class": cls}
+                body.update(extra or {})
+                self._gen_respond(429, body, rid=res.rid,
+                                  retry_after=True, slo_class=cls)
+                return
+            if res.finish_reason == "failed":
+                # Permanent engine failure drained this request: the
+                # partial output is salvaged, but the replica is done —
+                # clients should route elsewhere.
+                body = {"error": "engine failed during generation",
+                        "id": res.rid, "tokens": res.tokens,
+                        "finish_reason": "failed"}
+                body.update(extra or {})
+                self._gen_respond(503, body, rid=res.rid)
+                return
+            body = {
+                "id": res.rid,
+                "tokens": res.tokens,
+                "text": decode(list(res.prompt) + res.tokens),
+                "finish_reason": res.finish_reason,
+            }
+            digest = getattr(res, "prefix_digest", ())
+            if digest:
+                # What this replica's radix cache now holds for this
+                # prompt — the fleet router ingests these from the
+                # response body, so affinity needs no tokenizer and no
+                # replica-side push (ISSUE 15).
+                body["prefix_digest"] = list(digest)
+            body.update(extra or {})
+            self._gen_respond(200, body, rid=res.rid)
 
         def do_GET(self):
             url = urllib.parse.urlsplit(self.path)
@@ -497,6 +709,12 @@ def make_server(host: str, port: int, loop: EngineLoop,
                     return
                 self._json(200, {"ok": True, **res})
                 return
+            if self.path == "/internal/adopt":
+                self._do_internal_adopt()
+                return
+            if self.path == "/internal/export_done":
+                self._do_internal_export_done()
+                return
             if self.path != "/generate":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
@@ -523,6 +741,12 @@ def make_server(host: str, port: int, loop: EngineLoop,
                     kwargs["slo_class"] = str(payload["slo_class"])
                 if payload.get("priority") is not None:
                     kwargs["priority"] = int(payload["priority"])
+                if payload.get("migrate"):
+                    # Disaggregated serving (ISSUE 16): run the prefill
+                    # here, then answer 202 with the serialized block
+                    # chain instead of decoding — the router frontend
+                    # carries it to the decode tier.
+                    kwargs["migrate"] = True
             except (ValueError, TypeError, KeyError,
                     json.JSONDecodeError) as e:
                 # KeyError: a char tokenizer raises it for prompt chars
@@ -545,47 +769,103 @@ def make_server(host: str, port: int, loop: EngineLoop,
             except RuntimeError as e:     # loop died / engine failed
                 self._gen_respond(503, {"error": str(e)})
                 return
-            if res.finish_reason == "shed":
-                # Deadline expired in the queue (or the brownout ladder
-                # is shedding this class): the engine is healthy, THIS
-                # request lost — 429, try again when the queue has
-                # cleared (Retry-After says when, scaled by the
-                # requester's class). tokens are non-empty only for a
-                # recovery/preemption-requeued victim shed awaiting
-                # re-admission (the salvaged pre-fault output).
-                cls = kwargs.get("slo_class", "default")
-                self._gen_respond(
-                    429, {"error": "shed: deadline expired in the "
-                                   "queue (or brownout shed)",
-                          "id": res.rid, "tokens": res.tokens,
-                          "finish_reason": "shed",
-                          "slo_class": cls},
-                    rid=res.rid, retry_after=True, slo_class=cls)
+            if isinstance(res, ExportPayload):
+                # The request exported: its block chain + first token +
+                # seed are the response. 202 = accepted, not finished —
+                # the caller (normally the RouterFrontend) must resolve
+                # it via the decode tier + /internal/export_done, or
+                # this pod reclaims the handoff after export_timeout_s.
+                self._gen_respond(202, {"id": res.rid, "migrate": True,
+                                        "export": res.wire},
+                                  rid=res.rid)
                 return
-            if res.finish_reason == "failed":
-                # Permanent engine failure drained this request: the
-                # partial output is salvaged, but the replica is done —
-                # clients should route elsewhere.
-                self._gen_respond(
-                    503, {"error": "engine failed during generation",
-                          "id": res.rid, "tokens": res.tokens,
-                          "finish_reason": "failed"},
-                    rid=res.rid)
+            self._respond_result(res, slo_class=kwargs.get("slo_class"))
+
+        def _do_internal_adopt(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                wire = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(wire, dict) or "leaves" not in wire:
+                    raise ValueError("body must be an export payload "
+                                     "(disagg.export_to_wire)")
+                src = str(wire.get("src", ""))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e!r}"})
                 return
-            body = {
-                "id": res.rid,
-                "tokens": res.tokens,
-                "text": decode(list(res.prompt) + res.tokens),
-                "finish_reason": res.finish_reason,
-            }
-            digest = getattr(res, "prefix_digest", ())
-            if digest:
-                # What this replica's radix cache now holds for this
-                # prompt — the fleet router ingests these from the
-                # response body, so affinity needs no tokenizer and no
-                # replica-side push (ISSUE 15).
-                body["prefix_digest"] = list(digest)
-            self._gen_respond(200, body, rid=res.rid)
+            try:
+                got = loop.adopt(wire, src=src)
+            except (ValueError, KeyError) as e:
+                # Malformed/incompatible payload (wrong pool geometry,
+                # out-of-vocab first token): the SOURCE should fall
+                # back colocated, not retry another decode replica.
+                self._json(400, {"error": f"bad export payload: {e!r}"})
+                return
+            except (RuntimeError, TimeoutError) as e:
+                self._json(503, {"error": str(e)})
+                return
+            if got is None:
+                # Adoption backpressure — no free slot or blocks.
+                # retryable=True tells the frontend to try another
+                # decode replica before falling back.
+                self._json(503, {"error": "adoption backpressure: "
+                                          "no free slot/blocks",
+                                 "retryable": True},
+                           headers={"Retry-After": _retry_after()})
+                return
+            if isinstance(got, _Pending):
+                if not got.done.wait(request_timeout):
+                    self._json(504, {"error": "generation timed out"})
+                    return
+                if got.error is not None:
+                    self._json(503, {"error": str(got.error)})
+                    return
+                res = got.result
+            else:
+                res = got   # finished at admission (max_new_tokens==1)
+            self._respond_result(res, slo_class=wire.get("slo_class"),
+                                 extra={"adopted": True})
+
+        def _do_internal_export_done(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                rid = int(payload["rid"])
+                ok = bool(payload.get("ok"))
+                dst = str(payload.get("dst", ""))
+                copied = int(payload.get("copied_blocks", 0))
+                nbytes = int(payload.get("bytes", 0))
+            except (ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e!r}"})
+                return
+            try:
+                got = loop.export_done(rid, ok, dst=dst,
+                                       copied_blocks=copied,
+                                       bytes_moved=nbytes)
+            except (RuntimeError, TimeoutError) as e:
+                self._json(503, {"error": str(e)})
+                return
+            if got is None:
+                # Already reclaimed by timeout (or never ours): the
+                # request is resolving colocated here regardless.
+                self._json(410, {"error": f"unknown export rid {rid} "
+                                          "(reclaimed or never "
+                                          "exported)"})
+                return
+            if got is True:
+                self._json(200, {"ok": True, "id": rid})
+                return
+            # ok=False: the request was requeued colocated; block for
+            # its terminal Result so the frontend can answer the client
+            # from this one response (exactly-once, no second round).
+            if not got.done.wait(request_timeout):
+                self._json(504, {"error": "generation timed out"})
+                return
+            if got.error is not None:
+                self._json(503, {"error": str(got.error)})
+                return
+            self._respond_result(got.result,
+                                 extra={"migrate_fallback": True})
 
     return ThreadingHTTPServer((host, port), Handler)
 
@@ -668,9 +948,20 @@ class RouterFrontend:
     polled estimates (never just the shedding replica's) and a body
     naming the ready ``replica_set`` size.
 
+    Disaggregated serving (ISSUE 16): replicas announce their tier via
+    /stats ("role": prefill | decode | both). While BOTH tiers have a
+    ready member, /generate becomes a two-leg migration proxy — leg 1
+    routes phase="prefill" with the migrate flag and gets a 202 export
+    (the paged block chain as the wire format); leg 2 carries it to a
+    decode replica's /internal/adopt and confirms at the source with
+    /internal/export_done (adopt exhaustion => ok=false, the source
+    requeues colocated — the client always gets exactly one answer).
+    Mixed rollouts and tier outages degrade to the legacy colocated
+    flow automatically.
+
     Own endpoints: GET /healthz[?ready=1] (ready while >= 1 replica
     is), GET /debug/router (router + per-replica view), GET /metrics
-    (the serve_router_* families).
+    (the serve_router_* families plus the serve_migrations ledger).
     """
 
     def __init__(self, replicas: List[str], *, host: str = "0.0.0.0",
@@ -692,6 +983,16 @@ class RouterFrontend:
         for spec in self._specs:
             urls.extend(resolve_replicas(spec, default_port))
         self.metrics = MetricRegistry()
+        # Disaggregated serving (ISSUE 16): the frontend is the
+        # migration proxy, so the migration ledger lives here too —
+        # same family names the in-process DisaggPair exposes.
+        self._m_migrations = self.metrics.counter(
+            "serve_migrations_total",
+            "Prefill->decode migrations proxied, by outcome.",
+            labelnames=("outcome",))
+        self._m_migration_s = self.metrics.histogram(
+            "serve_migration_seconds",
+            "Wall seconds from 202 export to decode-tier adoption.")
         self.router = PrefixAffinityRouter(
             urls or ["http://unresolved.invalid:0"], page=page,
             affinity=affinity, index_cap=index_cap,
@@ -728,12 +1029,20 @@ class RouterFrontend:
             ready = st == 200 and bool(body.get("ready", body.get("ok")))
             reason = body.get("reason", "ok" if ready else "not ready")
             queued = active = brownout = 0
+            role = None
             if ready:
                 _, stats, _ = _http_json(f"{url}/stats", timeout=t)
                 queued = int(stats.get("queued", 0))
                 active = int(stats.get("active", 0))
                 bo = stats.get("brownout") or {}
                 brownout = int(bo.get("level", 0))
+                # Phase discovery (ISSUE 16): replicas announce their
+                # tier in /stats ("prefill"/"decode"/"both"); the router
+                # grows its phase dimension from the polls, no separate
+                # registration step.
+                r = stats.get("role")
+                if r in ("both", "prefill", "decode"):
+                    role = r
                 retry = stats.get("retry_after_s")
                 if retry is not None:
                     self._retry_by_replica[url] = float(retry)
@@ -744,9 +1053,10 @@ class RouterFrontend:
         except Exception as e:       # noqa: BLE001 — any poll failure
             ready, reason = False, f"unreachable: {type(e).__name__}"
             queued = active = brownout = 0
+            role = None
         self.router.update_replica(url, ready=ready, reason=reason,
                                    queued=queued, active=active,
-                                   brownout=brownout,
+                                   brownout=brownout, role=role,
                                    retry_after_s=self._retry_by_replica
                                    .get(url))
 
@@ -792,7 +1102,8 @@ class RouterFrontend:
     async def _respond(self, writer: asyncio.StreamWriter, code: int,
                        body: dict, headers: Optional[dict] = None
                        ) -> None:
-        phrase = {200: "OK", 400: "Bad Request", 404: "Not Found",
+        phrase = {200: "OK", 202: "Accepted", 400: "Bad Request",
+                  404: "Not Found", 410: "Gone",
                   429: "Too Many Requests", 502: "Bad Gateway",
                   503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(code, "OK")
@@ -882,6 +1193,18 @@ class RouterFrontend:
                 chain = []
         await self._proxy_generate(payload, chain, writer)
 
+    def _phase_tiering(self) -> bool:
+        """True when the fleet is actually disaggregated RIGHT NOW: at
+        least one ready prefill-role replica AND one ready decode-role
+        replica. Anything less (mixed rollout, decode tier down) routes
+        the legacy colocated way — graceful degradation, not an
+        outage."""
+        views = self.router.replicas
+        ready = [views[n] for n in self.router.ready_replicas()
+                 if n in views]
+        return (any(r.role == "prefill" for r in ready)
+                and any(r.role == "decode" for r in ready))
+
     async def _proxy_generate(self, payload: dict, chain: List[str],
                               writer: asyncio.StreamWriter) -> None:
         from nanosandbox_tpu.serve.router import NoReadyReplicaError
@@ -889,11 +1212,22 @@ class RouterFrontend:
         loop = asyncio.get_running_loop()
         tried: set = set()
         slo = payload.get("slo_class")
+        # Disaggregated two-leg flow (ISSUE 16): with both tiers ready,
+        # leg 1 routes phase="prefill" with the migrate flag; the 202
+        # export it answers with becomes leg 2's /internal/adopt body.
+        tiered = self._phase_tiering() and not payload.get(
+            "_no_migrate")
         while True:
             try:
-                dec = self.router.route(chain, exclude=tried,
-                                        failover=bool(tried))
+                dec = self.router.route(
+                    chain, exclude=tried, failover=bool(tried),
+                    phase="prefill" if tiered else None)
             except NoReadyReplicaError as e:
+                if tiered:
+                    # The prefill tier emptied mid-flight: retry the
+                    # whole ready set colocated before giving up.
+                    tiered = False
+                    continue
                 await self._respond(
                     writer, 503,
                     {"error": str(e), "replica_set": 0,
@@ -907,10 +1241,16 @@ class RouterFrontend:
                 # same-prefix follower in the same burst must route
                 # here too, not wait for this request to finish.
                 self.router.observe_digests(name, chain)
+            body_out = payload
+            if tiered:
+                body_out = {k: v for k, v in payload.items()
+                            if k != "_no_migrate"}
+                body_out["migrate"] = True
             try:
                 status, body, headers = await loop.run_in_executor(
                     self._proxy_pool, lambda: _http_json(
-                        f"{name}/generate", method="POST", body=payload,
+                        f"{name}/generate", method="POST",
+                        body=body_out,
                         timeout=self.request_timeout_s))
             except Exception as e:   # noqa: BLE001 — transport failure
                 self.router.update_replica(
@@ -918,6 +1258,9 @@ class RouterFrontend:
                     reason=f"unreachable: {type(e).__name__}")
                 tried.add(name)
                 continue
+            if status == 202 and isinstance(body.get("export"), dict):
+                await self._migrate_leg(name, body, chain, writer)
+                return
             if status == 503:
                 # This replica is leaving (drain/quarantine/failure):
                 # out of rotation now, re-route the request.
@@ -945,6 +1288,108 @@ class RouterFrontend:
                     name, list(body["prefix_digest"]))
             await self._respond(writer, status, body, extra_headers)
             return
+
+    async def _migrate_leg(self, src: str, export_body: dict,
+                           chain: List[str],
+                           writer: asyncio.StreamWriter) -> None:
+        """Leg 2 of the disaggregated flow: carry the 202 export from
+        ``src`` (the prefill replica) to a decode-tier replica's
+        /internal/adopt, then resolve the handoff at the source via
+        /internal/export_done. Exactly-once: the source keeps the
+        export parked until the callback — adopt success completes it,
+        adopt exhaustion makes ok=false requeue it COLOCATED at the
+        source, and the frontend answers the client from whichever leg
+        actually finished."""
+        from nanosandbox_tpu.serve.router import NoReadyReplicaError
+
+        loop = asyncio.get_running_loop()
+        wire = export_body["export"]
+        rid = export_body.get("id")
+        # Payload size ~= the transferred chain: base64 is 4/3 overhead.
+        nbytes = sum(len(leaf.get("data", "")) * 3 // 4
+                     for leaf in wire.get("leaves", [])
+                     if isinstance(leaf, dict))
+        t0 = time.monotonic()
+        tried = {src}
+        while True:
+            try:
+                dec = self.router.route(chain, exclude=tried,
+                                        failover=len(tried) > 1,
+                                        phase="decode")
+            except NoReadyReplicaError:
+                break
+            name = dec.replica
+            try:
+                status, body, headers = await loop.run_in_executor(
+                    self._proxy_pool, lambda: _http_json(
+                        f"{name}/internal/adopt", method="POST",
+                        body=wire, timeout=self.request_timeout_s))
+            except Exception as e:   # noqa: BLE001 — transport failure
+                self.router.update_replica(
+                    name, ready=False,
+                    reason=f"unreachable: {type(e).__name__}")
+                tried.add(name)
+                continue
+            if status in (200, 429):
+                # Adopted: the decode tier resolved the request (a 429
+                # is a post-adoption shed — still terminal THERE).
+                # Confirm at the source so it releases the chain WITH
+                # donation; best-effort — a lost callback self-heals by
+                # the source's export timeout.
+                try:
+                    await loop.run_in_executor(
+                        self._proxy_pool, lambda: _http_json(
+                            f"{src}/internal/export_done", method="POST",
+                            body={"rid": rid, "ok": True, "dst": name,
+                                  "copied_blocks": int(
+                                      wire.get("chain_blocks", 0)),
+                                  "bytes": nbytes},
+                            timeout=10.0))
+                except Exception:    # noqa: BLE001 — callback is advisory
+                    pass
+                self._m_migrations.labels(outcome="ok").inc()
+                self._m_migration_s.observe(time.monotonic() - t0)
+                body.setdefault("replica", name)
+                body["migrated_from"] = src
+                if status == 200 and body.get("prefix_digest"):
+                    self.router.observe_digests(
+                        name, list(body["prefix_digest"]))
+                fwd = ({"Retry-After": headers["Retry-After"]}
+                       if "Retry-After" in headers else None)
+                await self._respond(writer, status, body, fwd)
+                return
+            if status == 503 and body.get("retryable"):
+                tried.add(name)      # backpressure: stays in rotation
+                continue
+            if status == 503:
+                self.router.update_replica(name, ready=False,
+                                           reason="503 from replica")
+                tried.add(name)
+                continue
+            break                    # 400/unknown: fall back colocated
+        # No decode replica could adopt: ok=false tells the source to
+        # requeue colocated (same rid, pure prefix hit) and the call
+        # blocks until that fallback finishes — the client still gets
+        # exactly one answer.
+        self._m_migrations.labels(outcome="fallback").inc()
+        try:
+            status, body, headers = await loop.run_in_executor(
+                self._proxy_pool, lambda: _http_json(
+                    f"{src}/internal/export_done", method="POST",
+                    body={"rid": rid, "ok": False},
+                    timeout=self.request_timeout_s))
+        except Exception as e:       # noqa: BLE001 — source died too
+            self._m_migrations.labels(outcome="failed").inc()
+            await self._respond(
+                writer, 502,
+                {"error": f"migration fallback failed: {e!r}",
+                 "id": rid})
+            return
+        body.setdefault("replica", src)
+        fwd = ({"Retry-After": headers["Retry-After"]}
+               if "Retry-After" in headers else None)
+        await self._respond(writer, status, body, fwd)
+        return
 
     # ---------------------------------------------------------- lifecycle
     async def _main(self) -> None:

@@ -50,7 +50,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 def blocks_for(n_positions: int, page: int) -> int:
@@ -300,6 +300,8 @@ class BlockPool:
         self.evicted_blocks = 0
         self.requests = 0
         self.private_blocks_allocated = 0
+        self.adoptions = 0              # migrated chains re-admitted here
+        self.adopted_blocks = 0         # blocks filled by KV transfer
 
     # -- sizing -----------------------------------------------------------
     def blocks_needed(self, prompt_len: int, max_new: int) -> int:
@@ -373,6 +375,44 @@ class BlockPool:
                           table=[n.block for n in nodes] + fresh,
                           n_hit=n_hit, nodes=nodes)
 
+    def adopt_chain(self, prompt: Sequence[int],
+                    max_new: int) -> Optional[Tuple[Allocation, List[int]]]:
+        """Admit a MIGRATED request's block chain (ISSUE 16): the
+        disaggregated handoff's receiving half. The prompt's K/V
+        already exists on the source pool; this side allocates the same
+        footprint a local admission would (prompt chain + the full
+        generation budget) and tells the caller which chain positions
+        must be FILLED by a block copy before the request may decode.
+
+        Returns ``(alloc, copy)`` where ``copy`` lists the chain
+        positions (indices into ``alloc.table``) covering the prompt
+        that this pool does NOT already hold as a radix hit — every
+        such position's block is private and unwritten, and the caller
+        copies the source pool's block at the same chain position into
+        ``alloc.table[i]`` for each ``i``. A local prefix hit shrinks
+        the copy exactly like it shrinks a local prefill: hit blocks
+        are bit-identical to the source's by the chained-digest
+        argument (same tokens, same positions, paged layout is
+        position-independent), so skipping their transfer is free
+        bandwidth. The partial tail block (prompt not page-aligned) IS
+        copied — its K/V for [0, len(prompt)) was fully written by the
+        source's prefill. Generation-region blocks beyond the prompt
+        are never copied: nothing was ever written there.
+
+        None when the pool cannot cover the footprint (the caller
+        leaves the migration parked in limbo — adoption backpressure,
+        counted as a stall like any deferred admission). Refcount and
+        free/cached/owned partition invariants are admit()'s
+        unchanged: check() holds after adoption exactly as after a
+        local admission."""
+        a = self.admit(prompt, max_new)
+        if a is None:
+            return None
+        copy = list(range(a.n_hit, blocks_for(len(prompt), self.page)))
+        self.adoptions += 1
+        self.adopted_blocks += len(copy)
+        return a, copy
+
     def release(self, alloc: Allocation, *,
                 generated: Sequence[int] = (),
                 donate: bool = True) -> int:
@@ -433,6 +473,8 @@ class BlockPool:
         self.evicted_blocks = 0
         self.requests = 0
         self.private_blocks_allocated = 0
+        self.adoptions = 0
+        self.adopted_blocks = 0
 
     # -- introspection ----------------------------------------------------
     def stats(self) -> dict:
@@ -456,6 +498,8 @@ class BlockPool:
             "prefix_hit_rate": (self.hit_tokens / seen) if seen else None,
             "block_stall_steps": self.stall_steps,
             "evicted_blocks": self.evicted_blocks,
+            "adoptions": self.adoptions,
+            "adopted_blocks": self.adopted_blocks,
             "mean_private_blocks_per_request": (
                 self.private_blocks_allocated / self.requests
                 if self.requests else None),

@@ -135,6 +135,13 @@ class ReplicaView:
     retry_after_s: Optional[float] = None
     last_update_t: float = 0.0
     index: _PrefixIndex = field(default_factory=_PrefixIndex)
+    # Serving tier (ISSUE 16): "both" (colocated, the default),
+    # "prefill", or "decode". route(phase=...) only considers replicas
+    # whose role covers the request's phase — the phase dimension that
+    # turns the router into a two-tier dispatcher. Sticky: set at
+    # add_replica (the k8s tier annotation) and only changed by an
+    # explicit update.
+    role: str = "both"
 
     @property
     def load(self) -> int:
@@ -160,7 +167,8 @@ class PrefixAffinityRouter:
     def __init__(self, replicas: Iterable[str], *, page: int = 16,
                  index_cap: int = 8192, load_weight: float = 8.0,
                  brownout_weight: float = 64.0, affinity: bool = True,
-                 metrics=None, seed: int = 0):
+                 metrics=None, seed: int = 0,
+                 roles: Optional[Dict[str, str]] = None):
         import random as _random
 
         self.page = int(page)
@@ -170,8 +178,9 @@ class PrefixAffinityRouter:
         self.affinity = bool(affinity)
         self.index_cap = int(index_cap)
         self.replicas: Dict[str, ReplicaView] = {}
+        roles = roles or {}
         for name in replicas:
-            self.add_replica(name)
+            self.add_replica(name, role=roles.get(name, "both"))
         if not self.replicas:
             raise ValueError("router needs at least one replica")
         self.decisions: Dict[str, int] = {r: 0 for r in REASONS}
@@ -200,12 +209,16 @@ class PrefixAffinityRouter:
                 "last health refresh.", labelnames=("replica",))
 
     # ------------------------------------------------------------ updates
-    def add_replica(self, name: str) -> None:
+    def add_replica(self, name: str, *, role: str = "both") -> None:
         """Register a replica (headless-Service discovery may grow the
-        set at runtime); idempotent."""
+        set at runtime); idempotent. ``role`` is the tier annotation
+        ("both" | "prefill" | "decode") — see ReplicaView.role."""
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be 'both', 'prefill' or "
+                             f"'decode', got {role!r}")
         if name not in self.replicas:
             self.replicas[name] = ReplicaView(
-                name=name, index=_PrefixIndex(self.index_cap))
+                name=name, role=role, index=_PrefixIndex(self.index_cap))
 
     def remove_replica(self, name: str) -> None:
         """Deregister (scale-down, DNS churn). The label children a
@@ -220,13 +233,20 @@ class PrefixAffinityRouter:
     def update_replica(self, name: str, *, ready: bool,
                        reason: str = "", queued: int = 0, active: int = 0,
                        brownout: int = 0,
-                       retry_after_s: Optional[float] = None) -> None:
+                       retry_after_s: Optional[float] = None,
+                       role: Optional[str] = None) -> None:
         """One health-interval refresh: readiness (drain / quarantine /
         failure take the replica out of rotation HERE, which is why the
         rotation reacts within one interval), queue depth, brownout
-        level, and the replica's own retry estimate."""
+        level, and the replica's own retry estimate. ``role`` is sticky
+        (None leaves the tier annotation untouched)."""
         self.add_replica(name)
         r = self.replicas[name]
+        if role is not None:
+            if role not in ("both", "prefill", "decode"):
+                raise ValueError(f"role must be 'both', 'prefill' or "
+                                 f"'decode', got {role!r}")
+            r.role = role
         r.ready = bool(ready)
         r.reason = reason
         r.queued = int(queued)
@@ -267,21 +287,33 @@ class PrefixAffinityRouter:
 
     def route(self, chain: Sequence[str] = (), *,
               exclude: Iterable[str] = (),
-              failover: bool = False) -> RouteDecision:
+              failover: bool = False,
+              phase: Optional[str] = None) -> RouteDecision:
         """Pick a replica for a request whose prompt's digest chain is
         ``chain`` (empty = no affinity signal: dense engines, text-only
         HTTP requests). ``exclude`` removes replicas the caller already
         tried this request; ``failover=True`` marks the decision as a
         re-route (reason ``fallback``) regardless of what wins.
-        Raises NoReadyReplicaError when no candidate remains."""
+        ``phase`` (ISSUE 16) restricts candidates to the matching tier:
+        "prefill" routes an arriving request into the prefill tier,
+        "decode" picks the adoption target for a parked export —
+        colocated ("both") replicas serve either phase, so a mixed
+        fleet degrades gracefully to single-tier routing. Raises
+        NoReadyReplicaError when no candidate remains."""
+        if phase is not None and phase not in ("prefill", "decode"):
+            raise ValueError(f"phase must be 'prefill' or 'decode', "
+                             f"got {phase!r}")
         excluded = set(exclude)
         ready = [r for r in self.replicas.values()
-                 if r.ready and r.name not in excluded]
+                 if r.ready and r.name not in excluded
+                 and (phase is None or r.role in ("both", phase))]
         if not ready:
             raise NoReadyReplicaError(
-                "no ready replica (of "
+                ("no ready replica" if phase is None
+                 else f"no ready {phase}-tier replica") + " (of "
                 f"{len(self.replicas)}: "
-                + ", ".join(f"{r.name}={r.reason or 'excluded'}"
+                + ", ".join(f"{r.name}[{r.role}]="
+                            f"{r.reason or 'excluded'}"
                             for r in self.replicas.values()) + ")")
         ready.sort(key=lambda r: r.name)
         if not self.affinity:
@@ -349,6 +381,7 @@ class PrefixAffinityRouter:
             "replicas": {
                 r.name: {
                     "ready": r.ready,
+                    "role": r.role,
                     "reason": r.reason,
                     "queued": r.queued,
                     "active": r.active,

@@ -111,6 +111,14 @@ class SlotScheduler:
         # iterating _queues.values() live would race class
         # creation/removal.
         self._n = 0
+        # Migration limbo (ISSUE 16): requests whose prefill completed
+        # but whose block chain has not yet been adopted by a decode
+        # tier. Items here hold NO slot (the engine released the row at
+        # export) but DO hold prefill-side blocks, so they are real
+        # outstanding work: the deadline sweep must see them (the
+        # drain_expired fix below) and /debug/scheduler must show them.
+        # FIFO — migrations hand off in export order.
+        self._limbo: Deque = deque()
 
     # -- queue side --
     @staticmethod
@@ -170,6 +178,34 @@ class SlotScheduler:
     @property
     def free_slots(self) -> int:
         return len(self._free)
+
+    # -- migration limbo (ISSUE 16) --
+    @property
+    def limbo(self) -> int:
+        return len(self._limbo)
+
+    def park_limbo(self, item) -> None:
+        """Park an exported request awaiting decode-tier adoption
+        (tail — migrations hand off in export order)."""
+        self._limbo.append(item)
+
+    def park_limbo_front(self, item) -> None:
+        """Re-park at the HEAD — the adoption-side backpressure path
+        (decode tier had no slot/blocks this pump): the oldest export
+        must stay first in line or a stalled decode tier inverts the
+        handoff order and starves the head into a deadline shed."""
+        self._limbo.appendleft(item)
+
+    def pop_limbo(self):
+        """Claim the oldest parked export for transfer (None when
+        empty). Loop-thread only, like every mutator."""
+        return self._limbo.popleft() if self._limbo else None
+
+    def limbo_items(self) -> List:
+        """Snapshot of the limbo queue, oldest first (the
+        /debug/scheduler view; same C-level-copy safety argument as
+        queued_items)."""
+        return list(self._limbo)
 
     def bucket_for(self, prompt_len: int) -> int:
         """Smallest prefill-length rung >= prompt_len."""
@@ -246,25 +282,36 @@ class SlotScheduler:
         return items, slots, bucket
 
     def drain_expired(self, expired) -> List:
-        """Remove and return every queued item for which ``expired(item)``
-        is true, preserving FIFO order of the survivors — the engine's
-        deadline shed: a request whose deadline passed while it waited
-        is dropped from the queue (with a terminal ``shed`` outcome)
-        instead of burning slots on an answer its client stopped
-        waiting for. Cheap when nothing expired: the scan is attribute
-        checks only and the queue is rebuilt only on a hit."""
-        if not any(expired(item)
-                   for q in self._queues.values() for item in q):
-            return []
+        """Remove and return every queued OR limbo-parked item for which
+        ``expired(item)`` is true, preserving FIFO order of the
+        survivors — the engine's deadline shed: a request whose deadline
+        passed while it waited is dropped (with a terminal ``shed``
+        outcome) instead of burning slots on an answer its client
+        stopped waiting for. The migration limbo is swept with the SAME
+        predicate (ISSUE 16 fix — previously only the admission queue
+        was): a request parked mid-migration holds prefill-side blocks
+        and an unserved deadline exactly like a queued one, and a
+        stalled decode tier must not turn limbo into a leak. The caller
+        distinguishes queue items from limbo records by type and
+        releases a limbo victim's blocks WITHOUT donation. Cheap when
+        nothing expired: the scan is attribute checks only and each
+        queue is rebuilt only on a hit."""
         shed: List = []
-        for np in list(self._negprios):
-            p = -np
-            kept: Deque = deque()
-            for item in self._queues[p]:
-                (shed if expired(item) else kept).append(item)
-            self._queues[p] = kept
-            self._drop_if_empty(p)
-        self._n -= len(shed)
+        if any(expired(item)
+               for q in self._queues.values() for item in q):
+            for np in list(self._negprios):
+                p = -np
+                kept: Deque = deque()
+                for item in self._queues[p]:
+                    (shed if expired(item) else kept).append(item)
+                self._queues[p] = kept
+                self._drop_if_empty(p)
+            self._n -= len(shed)
+        if self._limbo and any(expired(item) for item in self._limbo):
+            kept_l: Deque = deque()
+            for item in self._limbo:
+                (shed if expired(item) else kept_l).append(item)
+            self._limbo = kept_l
         return shed
 
     def requeue_front(self, items: List) -> None:

@@ -1,0 +1,478 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 16).
+
+The contract under test:
+  * Parity: greedy outputs are token-identical between disaggregated
+    (DisaggPair: prefill tier -> block-chain migration -> decode tier)
+    and colocated serving, across paged x {fp32, int8, int4} kv pools
+    x scan_k {1, 4} — the adoption re-enters decode at pos = true_len
+    with the same fold_in(seed, pos + 1) keys a colocated engine would
+    have used.
+  * Ledger: the decode tier dispatches ZERO prefill programs — ever —
+    and its compiled set is a strict subset of a colocated engine's
+    (no widening; max_programs() budgets identical).
+  * Exactly-once: every pair rid resolves to exactly one terminal
+    across the handoff, including a replica_down fired INSIDE the
+    migration window (blocks reserved, nothing committed) — the
+    adoption unwinds, the export falls back colocated, and the merged
+    flight stream still carries one terminal per namespaced rid.
+  * Limbo hygiene: a deadline that expires while an export is parked
+    in migration limbo sheds with blocks released WITHOUT donation
+    (nothing warms the cache on refused traffic) and the pool's
+    partition/refcount invariants hold throughout.
+  * Wire: export_to_wire / adopt_from_wire survive a JSON round trip
+    with the same parity + zero-prefill guarantees, and adoption
+    backpressure surfaces as None (503-retryable upstream), never a
+    half-written pool.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanosandbox_tpu.config import GPTConfig
+from nanosandbox_tpu.models.gpt import GPT
+from nanosandbox_tpu.obs import TERMINAL_EVENTS
+from nanosandbox_tpu.serve import (DisaggPair, Engine, FaultPlan,
+                                   PrefixAffinityRouter, adopt_from_wire,
+                                   export_to_wire)
+from nanosandbox_tpu.serve.paged import BlockPool, blocks_for
+from nanosandbox_tpu.serve.router import NoReadyReplicaError
+from nanosandbox_tpu.serve.scheduler import SlotScheduler
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = GPTConfig(n_layer=2, n_head=2, n_embd=32, block_size=64,
+                    vocab_size=50, dropout=0.0, compute_dtype="float32",
+                    attention_impl="xla")
+    model = GPT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+ENGINE_KW = dict(num_slots=4, max_len=64, paged=True)
+
+
+def _requests(vocab=50, n=6, seed=0):
+    """Mixed greedy mix: varied lengths/budgets, some sharing a
+    prefix (the migration must respect radix hits on BOTH tiers)."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, vocab, 18).tolist()
+    out = []
+    for i in range(n):
+        if i % 3 == 0:
+            prompt = shared + rng.integers(0, vocab, 1 + i).tolist()
+        else:
+            prompt = rng.integers(0, vocab, 5 + 7 * i % 40).tolist()
+        out.append((prompt, 3 + (i % 4)))
+    return out
+
+
+def _colocated_reference(model, params, reqs, **kw):
+    eng = Engine(model, params, **{**ENGINE_KW, **kw})
+    rids = [eng.submit(p, m, temperature=0.0, seed=11 + i)
+            for i, (p, m) in enumerate(reqs)]
+    by_rid = {r.rid: r for r in eng.drain()}
+    return [by_rid[r] for r in rids]
+
+
+# ------------------------------------------------------------------ parity
+@pytest.mark.parametrize("kv_dtype,scan_k", [
+    (None, 1),
+    (None, 4),
+    ("int8", 1),
+    ("int4", 1),
+    pytest.param("int8", 4, marks=pytest.mark.slow),
+    pytest.param("int4", 4, marks=pytest.mark.slow),
+])
+def test_greedy_parity_disagg_vs_colocated(served_model, kv_dtype,
+                                           scan_k):
+    cfg, model, params = served_model
+    kw = dict(kv_dtype=kv_dtype, scan_k=scan_k)
+    reqs = _requests()
+    ref = _colocated_reference(model, params, reqs, **kw)
+
+    pair = DisaggPair(model, params, **{**ENGINE_KW, **kw})
+    pair_rids = [pair.submit(p, m, temperature=0.0, seed=11 + i)
+                 for i, (p, m) in enumerate(reqs)]
+    by_rid = {r.rid: r for r in pair.drain()}
+    assert set(by_rid) == set(pair_rids)
+    for i, pr in enumerate(pair_rids):
+        assert by_rid[pr].tokens == ref[i].tokens, (
+            f"req {i}: disagg {by_rid[pr].tokens} != "
+            f"colocated {ref[i].tokens}")
+        assert by_rid[pr].finish_reason == ref[i].finish_reason
+    # Every request actually took the migration path.
+    assert pair.migrations == len(reqs)
+    assert pair.fallbacks == 0
+    assert pair.decode.host_dispatches["prefill"] == 0
+
+
+# ------------------------------------------------------------------ ledger
+def test_decode_tier_zero_prefill_and_strict_subset(served_model):
+    cfg, model, params = served_model
+    reqs = _requests(n=8, seed=3)
+    coloc = Engine(model, params, **ENGINE_KW)
+    for i, (p, m) in enumerate(reqs):
+        coloc.submit(p, m, temperature=0.0, seed=i)
+    coloc.drain()
+
+    pair = DisaggPair(model, params, **ENGINE_KW)
+    for i, (p, m) in enumerate(reqs):
+        pair.submit(p, m, temperature=0.0, seed=i)
+    out = pair.drain()
+    assert len(out) == len(reqs)
+
+    d = pair.decode
+    # The dispatch ledger: NOT ONE prefill dispatch on the decode tier.
+    assert d.host_dispatches["prefill"] == 0
+    # Compile set: strict subset of the colocated engine's — no
+    # prefill programs at all, admit narrowed to the rung-1 adoption
+    # scatter, decode/release no wider.
+    assert d.trace_counts["prefill"] == 0 < coloc.trace_counts["prefill"]
+    assert d.trace_counts["admit"] == 1 <= coloc.trace_counts["admit"]
+    assert d.trace_counts["decode"] <= coloc.trace_counts["decode"]
+    assert d.trace_counts["release"] <= coloc.trace_counts["release"]
+    # ... and the guarded budgets did NOT widen to pay for it.
+    assert d.max_programs() == coloc.max_programs()
+    assert pair.prefill.max_programs() == coloc.max_programs()
+    # Pool invariants hold on both tiers after the workload drains.
+    pair.prefill.block_pool.check([])
+    pair.decode.block_pool.check([])
+    st = pair.stats()
+    assert st["tiers"]["decode"]["adopted"] == len(reqs)
+    assert st["tiers"]["prefill"]["migrated"] == len(reqs)
+
+
+# ------------------------------------------------------------- exactly-once
+@pytest.mark.parametrize("kill_step", [
+    2,
+    pytest.param(4, marks=pytest.mark.slow),
+])
+def test_replica_down_mid_migration_exactly_once(served_model,
+                                                 kill_step):
+    cfg, model, params = served_model
+    reqs = _requests(n=8, seed=5)
+    ref = _colocated_reference(model, params, reqs)
+
+    plan = FaultPlan.parse(f"replica_down@{kill_step}")
+    pair = DisaggPair(model, params, faults=plan, **ENGINE_KW)
+    pair_rids = [pair.submit(p, m, temperature=0.0, seed=11 + i)
+                 for i, (p, m) in enumerate(reqs)]
+    by_rid = {}
+    for _ in range(500):
+        for r in pair.step():
+            assert r.rid not in by_rid, f"duplicate terminal {r.rid}"
+            by_rid[r.rid] = r
+        if not pair.has_work():
+            break
+    assert set(by_rid) == set(pair_rids)
+    assert pair.replica_downs == 1
+    # The kill forces fallbacks, but greedy outputs stay identical:
+    # the colocated re-admission is a pure prefix hit resampling the
+    # same stream.
+    for i, pr in enumerate(pair_rids):
+        assert by_rid[pr].finish_reason == ref[i].finish_reason
+        assert by_rid[pr].tokens == ref[i].tokens
+    # Merged flight: exactly one terminal per namespaced engine rid.
+    terminals = {}
+    for ev in pair.merged_flight_events():
+        if ev["ev"] in TERMINAL_EVENTS and ev.get("rid") is not None:
+            assert ev["rid"] not in terminals, (
+                f"rid {ev['rid']} got two terminals")
+            terminals[ev["rid"]] = ev["ev"]
+    assert terminals, "no terminals recorded"
+    pair.prefill.block_pool.check([])
+
+
+def test_fallback_off_surfaces_failed(served_model):
+    cfg, model, params = served_model
+    plan = FaultPlan.parse("replica_down@0")
+    pair = DisaggPair(model, params, faults=plan, fallback=False,
+                      **ENGINE_KW)
+    rid = pair.submit([1, 2, 3, 4, 5], 4, temperature=0.0, seed=1)
+    out = pair.drain()
+    assert [r.rid for r in out].count(rid) == 1
+    res = next(r for r in out if r.rid == rid)
+    assert res.finish_reason == "failed"
+    # The sampled first token is salvaged into the failure.
+    assert len(res.tokens) >= 1
+
+
+# ------------------------------------------------------------------- limbo
+def test_limbo_deadline_shed_releases_without_donation(served_model):
+    cfg, model, params = served_model
+    eng = Engine(model, params, role="prefill", **ENGINE_KW)
+    free0 = eng.block_pool.free_blocks
+    rid = eng.submit([7] * 20, 5, temperature=0.0, seed=2,
+                     deadline_s=0.05, migrate=True)
+    # Step until the export parks in limbo; nobody pumps it.
+    for _ in range(50):
+        eng.step()
+        if eng.sched.limbo:
+            break
+    assert eng.sched.limbo == 1
+    time.sleep(0.08)
+    out = []
+    for _ in range(20):
+        out.extend(eng.step())
+        if out:
+            break
+    assert [r.rid for r in out] == [rid]
+    assert out[0].finish_reason == "shed"
+    assert eng.sched.limbo == 0
+    # Blocks came back WITHOUT donation: pool fully free, no cached
+    # chain left behind by traffic the engine refused to serve.
+    assert eng.block_pool.free_blocks == free0
+    assert eng.block_pool.stats()["trie_blocks"] == 0
+    eng.block_pool.check([])
+    # Exactly one terminal in the flight ledger.
+    evs = [e for e in eng.flight.events(rid=rid)
+           if e["ev"] in TERMINAL_EVENTS]
+    assert [e["ev"] for e in evs] == ["shed"]
+
+
+def test_scheduler_drain_expired_sweeps_limbo_unit():
+    class Item:
+        def __init__(self, rid, expired):
+            self.rid, self._expired = rid, expired
+
+    sched = SlotScheduler(2, [16, 32, 64])
+    sched.park_limbo(Item(1, False))
+    sched.park_limbo(Item(2, True))
+    sched.park_limbo(Item(3, True))
+    sched.park_limbo_front(Item(0, False))
+    swept = sched.drain_expired(lambda it: it._expired)
+    assert sorted(it.rid for it in swept) == [2, 3]
+    # Survivors keep order, head repark included.
+    assert [it.rid for it in sched.limbo_items()] == [0, 1]
+    assert sched.pop_limbo().rid == 0
+    assert sched.limbo == 1
+
+
+# -------------------------------------------------------------------- wire
+def test_wire_roundtrip_parity_and_json(served_model):
+    cfg, model, params = served_model
+    reqs = _requests(n=3, seed=9)
+    ref = _colocated_reference(model, params, reqs)
+
+    src = Engine(model, params, role="prefill", **ENGINE_KW)
+    dst = Engine(model, params, role="decode", **ENGINE_KW)
+    rids = [src.submit(p, m, temperature=0.0, seed=11 + i, migrate=True)
+            for i, (p, m) in enumerate(reqs)]
+    adopted = {}
+    for _ in range(200):
+        src.step()
+        while True:
+            exp = src.pop_export()
+            if exp is None:
+                break
+            wire = json.loads(json.dumps(export_to_wire(src, exp)))
+            got = adopt_from_wire(dst, wire, src="src")
+            assert got is not None
+            new_rid, done = got
+            adopted[new_rid] = rids.index(exp.req.rid)
+            src.complete_export(exp, dst="dst")
+            if done is not None:
+                pytest.fail("tiny budgets should not finish at adopt")
+        if len(adopted) == len(reqs) and not src.has_work():
+            break
+    assert len(adopted) == len(reqs)
+    by_rid = {r.rid: r for r in dst.drain()}
+    for new_rid, i in adopted.items():
+        assert by_rid[new_rid].tokens == ref[i].tokens
+    assert dst.host_dispatches["prefill"] == 0
+    assert dst.trace_counts["prefill"] == 0
+    assert src.migrated == len(reqs) and dst.adopted == len(reqs)
+    src.block_pool.check([])
+    dst.block_pool.check([])
+
+
+def test_wire_adopt_backpressure_returns_none(served_model):
+    cfg, model, params = served_model
+    src = Engine(model, params, role="prefill", **ENGINE_KW)
+    # A decode tier with ONE slot, already occupied: begin_adopt has
+    # no slot to reserve, adoption must refuse cleanly.
+    dst = Engine(model, params, num_slots=1, max_len=64, paged=True,
+                 role="decode")
+    src.submit([5] * 12, 6, temperature=0.0, seed=1, migrate=True)
+    exp = None
+    for _ in range(50):
+        src.step()
+        exp = src.pop_export()
+        if exp is not None:
+            break
+    assert exp is not None
+    wire = export_to_wire(src, exp)
+    got1 = adopt_from_wire(dst, wire, src="src")
+    assert got1 is not None            # first adoption takes the slot
+    got2 = adopt_from_wire(dst, wire, src="src")
+    assert got2 is None                # backpressure: no slot left
+    # The refused adoption left no blocks behind.
+    dst.drain()
+    dst.block_pool.check([])
+    src.repark_export(exp)
+    assert src.sched.limbo == 1
+
+
+# ------------------------------------------------------------- block pool
+def test_adopt_chain_ledger_and_refcounts():
+    bp = BlockPool(16, 4, prefix_cache=True)
+    prompt = list(range(10))               # 3 chain blocks
+    got = bp.adopt_chain(prompt, 4)
+    assert got is not None
+    alloc, copy = got
+    # Cold pool: every chain block must be copied.
+    assert copy == list(range(blocks_for(len(prompt), 4)))
+    bp.check([alloc])
+    bp.release(alloc, generated=(), donate=True)
+    bp.check([])
+    # Warm pool: the FULL blocks are a radix hit; only the partial
+    # tail block (10 % 4 = 2 positions — never donated) still copies.
+    got2 = bp.adopt_chain(prompt, 4)
+    assert got2 is not None
+    alloc2, copy2 = got2
+    assert copy2 == [2]
+    assert alloc2.n_hit == 2
+    bp.release(alloc2, donate=False)
+    bp.check([])
+    st = bp.stats()
+    assert st["adoptions"] == 2
+    assert st["adopted_blocks"] == len(copy) + len(copy2)
+
+
+# ------------------------------------------------------------------ router
+def test_router_phase_dimension():
+    r = PrefixAffinityRouter(["p0", "d0", "c0"], page=4,
+                             roles={"p0": "prefill", "d0": "decode"})
+    for name in ("p0", "d0", "c0"):
+        r.update_replica(name, ready=True)
+    assert r.replicas["c0"].role == "both"
+    assert r.route([], phase="prefill").replica in ("p0", "c0")
+    assert r.route([], phase="decode").replica in ("d0", "c0")
+    # Roles are sticky across health updates that do not mention them.
+    r.update_replica("p0", ready=True, queued=3)
+    assert r.replicas["p0"].role == "prefill"
+    # Phase exclusion: with the only decode-capable replicas excluded,
+    # the error names the phase.
+    with pytest.raises(NoReadyReplicaError) as ei:
+        r.route([], phase="decode", exclude={"d0", "c0"})
+    assert "decode" in str(ei.value)
+    with pytest.raises(ValueError):
+        r.route([], phase="verify")
+    with pytest.raises(ValueError):
+        r.add_replica("x", role="nonsense")
+    # A colocated fleet (all "both") serves either phase — graceful
+    # degradation during mixed rollouts.
+    r2 = PrefixAffinityRouter(["a", "b"], page=4)
+    for name in ("a", "b"):
+        r2.update_replica(name, ready=True)
+    assert r2.route([], phase="prefill").replica in ("a", "b")
+    assert r2.route([], phase="decode").replica in ("a", "b")
+
+
+# ----------------------------------------------------------------- metrics
+def test_pair_metrics_and_debug_views(served_model):
+    from nanosandbox_tpu.obs import render_prometheus
+
+    cfg, model, params = served_model
+    pair = DisaggPair(model, params, **ENGINE_KW)
+    pair.submit([3, 1, 4, 1, 5, 9, 2, 6], 3, temperature=0.0, seed=4)
+    pair.drain()
+    text = render_prometheus(pair.metrics)
+    assert 'serve_migrations_total{outcome="ok"} 1' in text
+    assert "serve_migration_seconds" in text
+    assert "serve_migration_limbo_depth" in text
+    ptext = render_prometheus(pair.prefill.metrics)
+    assert 'serve_engine_role{role="prefill"} 1' in ptext
+    assert "serve_migrated_out_total 1" in ptext
+    dtext = render_prometheus(pair.decode.metrics)
+    assert 'serve_engine_role{role="decode"} 1' in dtext
+    assert "serve_adopted_in_total 1" in dtext
+    dbg = pair.prefill.debug_scheduler()
+    assert dbg["role"] == "prefill"
+    assert "limbo_queue" in dbg and dbg["limbo"] == 0
+    st = pair.stats()
+    assert st["migrations"] == 1 and st["limbo"] == 0
+    assert st["migration_s"]["p50"] is not None
+
+
+# ------------------------------------------------------------------- http
+def test_http_two_tier_end_to_end(served_model):
+    """Prefill pod + decode pod + RouterFrontend: the migrate-flagged
+    /generate answers 202 at the source, the frontend carries the
+    chain to /internal/adopt, confirms via /internal/export_done, and
+    the client's tokens are identical to colocated serving."""
+    from nanosandbox_tpu.serve.http import (EngineLoop, RouterFrontend,
+                                            _http_json, make_server)
+
+    cfg, model, params = served_model
+
+    def enc(s):
+        return [ord(c) % 50 for c in s] or [0]
+
+    def dec(toks):
+        return "".join(chr(97 + (t % 26)) for t in toks)
+
+    pods = []
+
+    def pod(role, **kw):
+        eng = Engine(model, params, role=role, **{**ENGINE_KW, **kw})
+        loop = EngineLoop(eng)
+        loop.start()
+        srv = make_server("127.0.0.1", 0, loop, enc, dec,
+                          request_timeout=60.0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        pods.append((eng, loop, srv))
+        return eng, url
+
+    p_eng, p_url = pod("prefill")
+    d_eng, d_url = pod("decode")
+    fe = RouterFrontend([p_url, d_url], host="127.0.0.1", port=0,
+                        page=p_eng.kv_page_size,
+                        health_interval_s=0.2).start()
+    fe_url = f"http://127.0.0.1:{fe.port}"
+    try:
+        for _ in range(100):
+            _, body, _ = _http_json(f"{fe_url}/debug/router")
+            reps = body["router"]["replicas"]
+            if (all(r["ready"] for r in reps.values())
+                    and {r.get("role") for r in reps.values()}
+                    == {"prefill", "decode"}):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"role discovery failed: {reps}")
+
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        st, body, _ = _http_json(
+            f"{fe_url}/generate", method="POST",
+            body={"prompt_tokens": prompt, "max_new_tokens": 6,
+                  "temperature": 0.0, "seed": 7}, timeout=60.0)
+        assert st == 200, (st, body)
+        assert body["adopted"] is True
+        assert body["migrated_from"] == p_url
+        assert body["replica"] == d_url
+
+        coloc = Engine(model, params, **ENGINE_KW)
+        coloc.submit(prompt, 6, temperature=0.0, seed=7)
+        assert body["tokens"] == coloc.drain()[0].tokens
+
+        _, ps, _ = _http_json(f"{p_url}/stats")
+        _, ds, _ = _http_json(f"{d_url}/stats")
+        assert ps["role"] == "prefill" and ps["migrated"] == 1
+        assert ds["role"] == "decode" and ds["adopted"] == 1
+        assert ds["host_dispatches"]["prefill"] == 0
+    finally:
+        fe.stop()
+        for _, loop, srv in pods:
+            srv.shutdown()
+            srv.server_close()
+            loop.stop()
