@@ -35,6 +35,16 @@ every collective from the optimized HLO with bytes + mesh axes, flags
 accidental communication, and pins the result against the committed
 ``budgets/*.json`` in CI.
 
+The host-concurrency surface has its own pass:
+``python -m nanosandbox_tpu.analysis lockcheck`` (analysis/lockcheck/)
+classifies functions by execution context (stepping thread, HTTP
+handlers, asyncio loop, executors, timers, main), tracks ``with
+self._lock:`` regions and ``# guarded-by:`` declarations, and enforces
+shared-write guarding, the committed lock order
+(``budgets/lock_order.json``), no blocking under a lock, no sync I/O on
+the event loop, and no leaked acquires. Its runtime witness is
+``nanosandbox_tpu.utils.schedcheck`` (seeded schedule-fuzz harness).
+
 Suppress a deliberate violation with a REASONED comment (the reason is
 mandatory; a bare disable is itself a finding)::
 

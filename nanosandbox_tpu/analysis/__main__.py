@@ -1,6 +1,6 @@
 """CLI: ``python -m nanosandbox_tpu.analysis [options] <paths>``.
 
-Two tools, one entry point:
+Three tools, one entry point:
 
   * jaxlint (default) — the jax-free AST linter. Exit status is the CI
     gate: 0 clean, 1 findings, 2 usage error. The JSON report
@@ -11,6 +11,11 @@ Two tools, one entry point:
     (``python -m nanosandbox_tpu.analysis shardcheck --help``); this
     one compiles programs and therefore imports jax. See
     docs/playbook.md "Sharding analysis".
+  * ``lockcheck`` subcommand — the jax-free concurrency analyzer for
+    the serving host layer (``python -m nanosandbox_tpu.analysis
+    lockcheck --help``); same flags and exit codes as jaxlint plus a
+    committed lock-ordering file. See docs/playbook.md "Concurrency
+    analysis".
 """
 
 from __future__ import annotations
@@ -71,6 +76,10 @@ def main(argv=None) -> int:
         from nanosandbox_tpu.analysis.shardcheck.cli import main as sc_main
 
         return sc_main(argv[1:])
+    if argv and argv[0] == "lockcheck":
+        from nanosandbox_tpu.analysis.lockcheck.cli import main as lc_main
+
+        return lc_main(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m nanosandbox_tpu.analysis",

@@ -1,0 +1,283 @@
+"""lockcheck framework: registry, suppressions, lock order, reports.
+
+Same shape as the jaxlint framework (``analysis/core.py``) and reusing
+its :class:`Finding`/:class:`Suppression` machinery, but a separate
+tool: its own ``# lockcheck: disable=<rule> -- <why>`` comment tag, its
+own rule registry, and one extra input — the committed lock-ordering
+file (``budgets/lock_order.json``), the concurrency analogue of
+shardcheck's committed collective budgets. Pure ast + stdlib; no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import ast
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from nanosandbox_tpu.analysis.core import (Finding, Suppression,
+                                           _suppression_for,
+                                           iter_python_files)
+from nanosandbox_tpu.analysis.lockcheck.contexts import ConcurrencyIndex
+
+JSON_SCHEMA_VERSION = 1
+
+# Spelled without the leading hash so this comment is not itself a
+# suppression: `lockcheck: disable=blocking-under-lock -- why`.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lockcheck:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*?))?\s*$")
+
+# Default location of the committed lock ordering, relative to repo
+# root (the CLI also takes --lock-order=PATH).
+DEFAULT_LOCK_ORDER = "budgets/lock_order.json"
+
+
+@dataclass
+class LockOrder:
+    """The canonical acquisition order: tiers, earliest-first, and the
+    qualified lock ids pinned to each tier. Acquiring a lock in an
+    EARLIER tier while holding one from a LATER tier inverts the order;
+    intra-tier nesting is allowed (it cannot deadlock against the
+    committed order, and the inversion rule's cycle check still catches
+    genuine intra-tier cycles)."""
+    tiers: Tuple[str, ...] = ()
+    locks: Dict[str, str] = field(default_factory=dict)  # lock id -> tier
+
+    def tier_index(self, lock: str) -> Optional[int]:
+        tier = self.locks.get(lock)
+        if tier is None:
+            return None
+        try:
+            return self.tiers.index(tier)
+        except ValueError:
+            return None
+
+
+def load_lock_order(path: str) -> LockOrder:
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    tiers = tuple(data.get("order", ()))
+    locks = dict(data.get("locks", {}))
+    for lock, tier in locks.items():
+        if tier not in tiers:
+            raise ValueError(
+                f"lock {lock!r} pinned to unknown tier {tier!r}; "
+                f"order file declares {list(tiers)}")
+    return LockOrder(tiers=tiers, locks=locks)
+
+
+@dataclass
+class ModuleContext:
+    """Everything a lockcheck rule needs about one source file."""
+    path: str
+    source: str
+    tree: ast.Module
+    conc: ConcurrencyIndex
+    lines: List[str] = field(default_factory=list)
+    lock_order: Optional[LockOrder] = None
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``doc`` and implement check()."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: Callable[[], Rule]):
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"rule {rule_cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+_BUILTINS_LOADED = False
+
+
+def all_rules() -> Dict[str, Rule]:
+    global _BUILTINS_LOADED
+    if not _BUILTINS_LOADED:
+        _BUILTINS_LOADED = True
+        from nanosandbox_tpu.analysis.lockcheck import rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    """Extract ``# lockcheck: disable=...`` comments via tokenize (a
+    'lockcheck:' inside a string literal must not suppress)."""
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        standalone = tok.line.strip().startswith("#")
+        out.append(Suppression(line=tok.start[0], rules=rules,
+                               reason=reason, standalone=standalone))
+    return out
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select: Optional[Sequence[str]] = None,
+                   strict_suppressions: bool = False,
+                   lock_order: Optional[LockOrder] = None,
+                   ) -> Tuple[List[Finding], int]:
+    """Lint one source string. Returns (findings, suppressed_count).
+
+    Suppression semantics match jaxlint exactly: reasons are mandatory
+    (a bare disable is void AND a bad-suppression finding), a
+    standalone comment covers the next statement if only comments and
+    blanks sit between, and reasoned suppressions that no longer match
+    are reported as unused (promoted to findings under
+    ``strict_suppressions``).
+    """
+    rules = all_rules()
+    if select:
+        unknown = sorted(set(select) - set(rules))
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(unknown)}; "
+                             f"known: {', '.join(sorted(rules))}")
+        rules = {k: v for k, v in rules.items() if k in select}
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "parse-error",
+                        f"could not parse: {e.msg}")], 0
+
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        conc=ConcurrencyIndex(tree, source),
+                        lines=source.splitlines(), lock_order=lock_order)
+    raw: List[Finding] = []
+    for rule in rules.values():
+        raw.extend(rule.check(ctx))
+
+    suppressions = parse_suppressions(source)
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in sorted(set(raw), key=lambda f: f.key()):
+        s = _suppression_for(suppressions, f, ctx.lines)
+        if s is None:
+            findings.append(f)
+        elif not s.reason:
+            s.used = True
+            findings.append(f)
+        else:
+            s.used = True
+            suppressed += 1
+    known = set(all_rules()) | {"all", "parse-error", "bad-suppression",
+                                "unused-suppression"}
+    for s in suppressions:
+        if not s.reason:
+            findings.append(Finding(
+                path, s.line, 0, "bad-suppression",
+                "suppression without a reason — write "
+                "'# lockcheck: disable=<rule> -- <why this is "
+                "deliberate>'"))
+        for r in s.rules:
+            if r not in known:
+                findings.append(Finding(
+                    path, s.line, 0, "bad-suppression",
+                    f"unknown rule id {r!r} in suppression — known: "
+                    f"{', '.join(sorted(set(all_rules())))}"))
+        if (s.reason and not s.used
+                and (select is None
+                     or ("all" not in s.rules
+                         and all(r in select for r in s.rules)))):
+            _UNUSED_LOG.append({
+                "file": path, "line": s.line,
+                "rules": list(s.rules), "reason": s.reason})
+            if strict_suppressions:
+                findings.append(Finding(
+                    path, s.line, 0, "unused-suppression",
+                    f"suppression for {', '.join(s.rules)} no longer "
+                    "matches any finding — the audited violation is "
+                    "gone; delete the comment (reason was: "
+                    f"{s.reason!r})"))
+    return sorted(set(findings), key=lambda f: f.key()), suppressed
+
+
+_UNUSED_LOG: List[dict] = []
+
+
+def drain_unused_suppressions() -> List[dict]:
+    out, _UNUSED_LOG[:] = list(_UNUSED_LOG), []
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Sequence[str]] = None,
+                  strict_suppressions: bool = False,
+                  lock_order: Optional[LockOrder] = None) -> dict:
+    """Lint files/directories; returns the report dict render_json dumps."""
+    findings: List[Finding] = []
+    suppressed = 0
+    drain_unused_suppressions()
+    files = iter_python_files(paths)
+    for f in files:
+        try:
+            src = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(str(f), 1, 0, "parse-error",
+                                    f"could not read: {e}"))
+            continue
+        fs, sup = analyze_source(src, str(f), select=select,
+                                 strict_suppressions=strict_suppressions,
+                                 lock_order=lock_order)
+        findings.extend(fs)
+        suppressed += sup
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "lockcheck",
+        "findings": [vars(f) for f in findings],
+        "unused_suppressions": drain_unused_suppressions(),
+        "summary": {
+            "files_scanned": len(files),
+            "findings": len(findings),
+            "suppressed": suppressed,
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+    }
+
+
+def render_text(report: dict) -> str:
+    lines = [f"{f['file']}:{f['line']}:{f['col']}: {f['rule']}: "
+             f"{f['message']}" for f in report["findings"]]
+    unused = report.get("unused_suppressions", [])
+    lines.extend(
+        f"{u['file']}:{u['line']}: note: unused suppression for "
+        f"{', '.join(u['rules'])} (use --strict-suppressions to fail "
+        "on these)" for u in unused)
+    s = report["summary"]
+    lines.append(f"lockcheck: {s['findings']} finding(s) in "
+                 f"{s['files_scanned']} file(s), "
+                 f"{s['suppressed']} suppressed"
+                 + (f", {len(unused)} unused suppression(s)" if unused
+                    else ""))
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=False)

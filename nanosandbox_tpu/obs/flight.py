@@ -413,11 +413,20 @@ class WatchdogPanel:
         with self._dump_lock:
             try:
                 if self.dump_dir is None:
+                    # lockcheck: disable=blocking-under-lock -- the
+                    # dump I/O under _dump_lock IS the feature: this
+                    # lock exists solely to serialize whole snapshot
+                    # writes against each other (docstring above,
+                    # regression-pinned), nothing latency-sensitive
+                    # ever contends on it, and trips are cooldown-
+                    # limited cold events.
                     self.dump_dir = tempfile.mkdtemp(
                         prefix="serve-watchdog-")
                 d = os.path.join(
                     self.dump_dir,
                     f"{kind}-{self.trips[kind]}-{int(time.time())}")
+                # lockcheck: disable=blocking-under-lock -- same
+                # deliberate serialization as the mkdtemp above.
                 os.makedirs(d, exist_ok=True)
                 self.engine.flight.dump(
                     os.path.join(d, f"flight-{kind}.jsonl"))

@@ -1701,24 +1701,33 @@ class Engine:
         steps = int(steps)
         if steps < 1:
             raise ValueError(f"profile steps must be >= 1, got {steps}")
+        # Create/validate the dir BEFORE taking _profile_lock: the one
+        # stepping thread takes this lock inside step() once a window is
+        # armed, so filesystem I/O under it would let a slow /tmp stall
+        # serving (lockcheck: blocking-under-lock). Validation stays on
+        # the arming thread, where failure is a clean 400 — a bad path
+        # surfacing later inside start_trace on the stepping thread
+        # would kill the whole serving loop for one bad request.
+        auto = out_dir is None
+        d = out_dir or tempfile.mkdtemp(prefix="serve-profile-")
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            raise ValueError(f"unusable profile dir {d!r}: {e}") from e
         with self._profile_lock:
             if self._profile is not None and self._profile["started"]:
+                # Roll back the tempdir this losing arm just created.
+                if auto:
+                    try:
+                        os.rmdir(d)
+                    except OSError:
+                        pass
                 raise RuntimeError("a profile window is already in progress")
             # An armed-but-unstarted window (no traffic arrived yet) is
             # simply replaced — 409ing on it would wedge /profile
             # behind a window nothing is profiling, with no way out
             # until unrelated traffic drains it.
             self._reap_unstarted_dir()
-            auto = out_dir is None
-            d = out_dir or tempfile.mkdtemp(prefix="serve-profile-")
-            # Validate the (possibly user-supplied) dir HERE, on the
-            # arming thread, where failure is a clean 400 — a bad path
-            # surfacing later inside start_trace on the stepping thread
-            # would kill the whole serving loop for one bad request.
-            try:
-                os.makedirs(d, exist_ok=True)
-            except OSError as e:
-                raise ValueError(f"unusable profile dir {d!r}: {e}") from e
             self._profile = {"dir": d, "auto_dir": auto, "steps": steps,
                              "remaining": steps, "started": False,
                              "span": 0, "sync_mark": None}

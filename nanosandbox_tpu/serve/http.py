@@ -665,8 +665,27 @@ def make_server(host: str, port: int, loop: EngineLoop,
             elif url.path == "/debug/prefix_summary":
                 # The fleet router's authoritative index refresh
                 # (ISSUE 15): chained fingerprints of every resident
-                # radix-cache chain prefix. Host bookkeeping only.
-                self._json(200, loop.engine.prefix_summary())
+                # radix-cache chain prefix. Host bookkeeping only —
+                # but unlike the snapshot-reading /debug views, this
+                # WALKS the radix trie (cache.digests() iterates live
+                # children dicts the loop thread grows and evicts), so
+                # it must run ON the loop thread via the call() marshal:
+                # a handler-thread walk racing insert_chain/evict dies
+                # with "dictionary changed size during iteration"
+                # (schedcheck finding, fuzz_engine_loop).
+                try:
+                    summary = loop.call(
+                        lambda eng: eng.prefix_summary())
+                except RuntimeError:
+                    # Loop dead: nothing mutates the trie anymore, so
+                    # a direct read is safe and keeps the endpoint
+                    # usable for post-mortems.
+                    summary = loop.engine.prefix_summary()
+                except TimeoutError:
+                    self._json(503, {"error": "engine loop busy; "
+                                              "retry prefix_summary"})
+                    return
+                self._json(200, summary)
             elif url.path == "/debug/slots":
                 self._json(200, loop.engine.debug_slots())
             elif url.path == "/debug/kvpool":
@@ -1393,9 +1412,19 @@ class RouterFrontend:
 
     # ---------------------------------------------------------- lifecycle
     async def _main(self) -> None:
+        # Publish-once fields: written exactly once here on the router
+        # loop, strictly BEFORE the _started barrier below — start()
+        # blocks on that Event, so every other thread (stop(), tests
+        # reading .port) observes the final values.
+        # lockcheck: disable=unguarded-shared-write -- single
+        # assignment sequenced before the _started.set() barrier;
+        # readers only run after start() returns.
         self._stopping = asyncio.Event()
         server = await asyncio.start_server(self._handle, self.host,
                                             self.port)
+        # lockcheck: disable=unguarded-shared-write -- same _started
+        # barrier as _stopping above: bound-port readback is published
+        # before any reader can exist.
         self.port = server.sockets[0].getsockname()[1]
         health = asyncio.create_task(self._health_loop())
         self._started.set()

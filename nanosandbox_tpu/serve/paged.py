@@ -543,7 +543,13 @@ class BlockPool:
         trie: dict = {"enabled": self.cache is not None}
         if self.cache is not None:
             depths: Dict[int, int] = {}
-            for n in self.cache._nodes:
+            # list() snapshot: debug() is read from handler threads
+            # while the loop thread inserts/evicts nodes, and iterating
+            # the live list would crash mid-mutation (the engine debug
+            # discipline — torn reads yield a stale view, never a
+            # crash). Parent pointers of an evicted node stay intact,
+            # so the depth walk below is safe on the snapshot.
+            for n in list(self.cache._nodes):
                 d = 0
                 p = n.parent
                 while p is not None:

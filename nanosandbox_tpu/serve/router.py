@@ -36,10 +36,23 @@ contract): no jax import, no device state, nothing on any engine's hot
 loop. The in-process harness (serve/fleet.py) and the asyncio HTTP
 front tier (serve/http.py) both drive this one class, so the routing
 policy tested on one host is the policy the k8s router Deployment runs.
+
+Thread safety: the HTTP front tier calls into one router instance from
+THREE contexts at once — route() and stats() on the asyncio loop
+thread, update_replica()/refresh_summary() from health-poll executor
+threads, add_replica()/remove_replica() from discovery resolution —
+and ``self.replicas`` is a plain dict whose iteration (route's ready
+scan) crashes outright when a poll mutates it mid-walk. Every public
+method therefore serializes on ``self._lock`` (an RLock:
+update_replica re-enters through add_replica). Nothing under the lock
+blocks — pure dict/score work, microseconds — so the serialization is
+invisible next to a single proxied request. The lock sits in the
+``engine`` tier of budgets/lock_order.json.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -171,19 +184,20 @@ class PrefixAffinityRouter:
                  roles: Optional[Dict[str, str]] = None):
         import random as _random
 
+        self._lock = threading.RLock()
         self.page = int(page)
         self._rng = _random.Random(seed)
         self.load_weight = float(load_weight)
         self.brownout_weight = float(brownout_weight)
         self.affinity = bool(affinity)
         self.index_cap = int(index_cap)
-        self.replicas: Dict[str, ReplicaView] = {}
+        self.replicas: Dict[str, ReplicaView] = {}  # guarded-by: _lock
         roles = roles or {}
         for name in replicas:
             self.add_replica(name, role=roles.get(name, "both"))
         if not self.replicas:
             raise ValueError("router needs at least one replica")
-        self.decisions: Dict[str, int] = {r: 0 for r in REASONS}
+        self.decisions: Dict[str, int] = {r: 0 for r in REASONS}  # guarded-by: _lock
         self._rr = int(seed)         # rotates load-tie picks
         self._m_decisions = None
         self._m_hit_est = None
@@ -216,19 +230,22 @@ class PrefixAffinityRouter:
         if role not in ("both", "prefill", "decode"):
             raise ValueError(f"role must be 'both', 'prefill' or "
                              f"'decode', got {role!r}")
-        if name not in self.replicas:
-            self.replicas[name] = ReplicaView(
-                name=name, role=role, index=_PrefixIndex(self.index_cap))
+        with self._lock:
+            if name not in self.replicas:
+                self.replicas[name] = ReplicaView(
+                    name=name, role=role,
+                    index=_PrefixIndex(self.index_cap))
 
     def remove_replica(self, name: str) -> None:
         """Deregister (scale-down, DNS churn). The label children a
         registry already minted persist in the exposition, so zero the
         gauges on the way out — a pod that left must not keep
         exporting ready=1 to the dashboards forever."""
-        if name in self.replicas and self._m_ready is not None:
-            self._m_ready.labels(replica=name).set(0.0)
-            self._m_load.labels(replica=name).set(0.0)
-        self.replicas.pop(name, None)
+        with self._lock:
+            if name in self.replicas and self._m_ready is not None:
+                self._m_ready.labels(replica=name).set(0.0)
+                self._m_load.labels(replica=name).set(0.0)
+            self.replicas.pop(name, None)
 
     def update_replica(self, name: str, *, ready: bool,
                        reason: str = "", queued: int = 0, active: int = 0,
@@ -240,50 +257,56 @@ class PrefixAffinityRouter:
         rotation reacts within one interval), queue depth, brownout
         level, and the replica's own retry estimate. ``role`` is sticky
         (None leaves the tier annotation untouched)."""
-        self.add_replica(name)
-        r = self.replicas[name]
-        if role is not None:
-            if role not in ("both", "prefill", "decode"):
-                raise ValueError(f"role must be 'both', 'prefill' or "
-                                 f"'decode', got {role!r}")
-            r.role = role
-        r.ready = bool(ready)
-        r.reason = reason
-        r.queued = int(queued)
-        r.active = int(active)
-        r.brownout = int(brownout)
-        r.retry_after_s = retry_after_s
-        r.last_update_t = time.monotonic()
-        if self._m_ready is not None:
-            self._m_ready.labels(replica=name).set(1.0 if r.ready else 0.0)
-            self._m_load.labels(replica=name).set(float(r.load))
+        with self._lock:
+            self.add_replica(name)
+            r = self.replicas[name]
+            if role is not None:
+                if role not in ("both", "prefill", "decode"):
+                    raise ValueError(f"role must be 'both', 'prefill' or "
+                                     f"'decode', got {role!r}")
+                r.role = role
+            r.ready = bool(ready)
+            r.reason = reason
+            r.queued = int(queued)
+            r.active = int(active)
+            r.brownout = int(brownout)
+            r.retry_after_s = retry_after_s
+            r.last_update_t = time.monotonic()
+            if self._m_ready is not None:
+                self._m_ready.labels(replica=name).set(
+                    1.0 if r.ready else 0.0)
+                self._m_load.labels(replica=name).set(float(r.load))
 
     def observe_digests(self, name: str, digests: Sequence[str]) -> None:
         """Opportunistic index update from one finished request's
         prefix_digest report: replica ``name`` now caches this chain."""
-        if digests and name in self.replicas:
-            self.replicas[name].index.add_chain(digests)
+        with self._lock:
+            if digests and name in self.replicas:
+                self.replicas[name].index.add_chain(digests)
 
     def refresh_summary(self, name: str, digests: Sequence[str]) -> None:
         """Authoritative replacement from the replica's
         /debug/prefix_summary — the staleness/eviction path: digests
         the replica LRU-evicted since the last refresh disappear from
         the router's index with it."""
-        if name in self.replicas:
-            self.replicas[name].index.replace(digests)
+        with self._lock:
+            if name in self.replicas:
+                self.replicas[name].index.replace(digests)
 
     def forget(self, name: str) -> None:
         """Drop a replica's index (it died, recovered with a flushed
         cache, or reset) without deregistering it."""
-        if name in self.replicas:
-            self.replicas[name].index.clear()
+        with self._lock:
+            if name in self.replicas:
+                self.replicas[name].index.clear()
 
     # ------------------------------------------------------------ routing
     def match_tokens(self, name: str, chain: Sequence[str]) -> int:
-        r = self.replicas.get(name)
-        if r is None:
-            return 0
-        return r.index.match_blocks(chain) * self.page
+        with self._lock:
+            r = self.replicas.get(name)
+            if r is None:
+                return 0
+            return r.index.match_blocks(chain) * self.page
 
     def route(self, chain: Sequence[str] = (), *,
               exclude: Iterable[str] = (),
@@ -304,92 +327,99 @@ class PrefixAffinityRouter:
             raise ValueError(f"phase must be 'prefill' or 'decode', "
                              f"got {phase!r}")
         excluded = set(exclude)
-        ready = [r for r in self.replicas.values()
-                 if r.ready and r.name not in excluded
-                 and (phase is None or r.role in ("both", phase))]
-        if not ready:
-            raise NoReadyReplicaError(
-                ("no ready replica" if phase is None
-                 else f"no ready {phase}-tier replica") + " (of "
-                f"{len(self.replicas)}: "
-                + ", ".join(f"{r.name}[{r.role}]="
-                            f"{r.reason or 'excluded'}"
-                            for r in self.replicas.values()) + ")")
-        ready.sort(key=lambda r: r.name)
-        if not self.affinity:
-            # The affinity-blind baseline: seeded uniform-random over
-            # the ready set (class docstring explains why not
-            # least-loaded-with-rotation).
-            best = self._rng.choice(ready)
-            reason = "fallback" if (failover or excluded) else "load"
+        with self._lock:
+            ready = [r for r in self.replicas.values()
+                     if r.ready and r.name not in excluded
+                     and (phase is None or r.role in ("both", phase))]
+            if not ready:
+                raise NoReadyReplicaError(
+                    ("no ready replica" if phase is None
+                     else f"no ready {phase}-tier replica") + " (of "
+                    f"{len(self.replicas)}: "
+                    + ", ".join(f"{r.name}[{r.role}]="
+                                f"{r.reason or 'excluded'}"
+                                for r in self.replicas.values()) + ")")
+            ready.sort(key=lambda r: r.name)
+            if not self.affinity:
+                # The affinity-blind baseline: seeded uniform-random
+                # over the ready set (class docstring explains why not
+                # least-loaded-with-rotation).
+                best = self._rng.choice(ready)
+                reason = "fallback" if (failover or excluded) else "load"
+                self.decisions[reason] += 1
+                if self._m_decisions is not None:
+                    self._m_decisions.labels(reason=reason).inc()
+                    self._m_hit_est.observe(0)
+                return RouteDecision(replica=best.name, reason=reason,
+                                     est_hit_tokens=0,
+                                     candidates=len(ready))
+            # Stable candidate rotation: ties (fresh fleet, equal load)
+            # spread round-robin instead of piling the whole warmup on
+            # one replica; the rotation point advances per decision.
+            self._rr += 1
+            ready = (ready[self._rr % len(ready):]
+                     + ready[:self._rr % len(ready)])
+            hits = {r.name: (r.index.match_blocks(chain) * self.page
+                             if chain else 0)
+                    for r in ready}
+
+            def score(r: ReplicaView) -> float:
+                return (hits[r.name] - self.load_weight * r.load
+                        - self.brownout_weight * r.brownout)
+
+            best = max(ready, key=score)
+            est = hits[best.name]
+            if failover or excluded:
+                reason = "fallback"
+            elif est > 0:
+                reason = "affinity"
+            else:
+                # No affinity among the READY set — if a non-ready/
+                # excluded replica held the prefix, this is traffic
+                # redirected off its warm home, which an operator reads
+                # differently from plain cold load-balancing.
+                warm_elsewhere = any(
+                    self.affinity and chain
+                    and r.index.match_blocks(chain) > 0
+                    for r in self.replicas.values()
+                    if not r.ready or r.name in excluded)
+                reason = "fallback" if warm_elsewhere else "load"
             self.decisions[reason] += 1
             if self._m_decisions is not None:
                 self._m_decisions.labels(reason=reason).inc()
-                self._m_hit_est.observe(0)
+                self._m_hit_est.observe(est)
             return RouteDecision(replica=best.name, reason=reason,
-                                 est_hit_tokens=0,
+                                 est_hit_tokens=est,
                                  candidates=len(ready))
-        # Stable candidate rotation: ties (fresh fleet, equal load)
-        # spread round-robin instead of piling the whole warmup on one
-        # replica; the rotation point advances per decision.
-        self._rr += 1
-        ready = ready[self._rr % len(ready):] + ready[:self._rr % len(ready)]
-        hits = {r.name: (r.index.match_blocks(chain) * self.page
-                         if chain else 0)
-                for r in ready}
-
-        def score(r: ReplicaView) -> float:
-            return (hits[r.name] - self.load_weight * r.load
-                    - self.brownout_weight * r.brownout)
-
-        best = max(ready, key=score)
-        est = hits[best.name]
-        if failover or excluded:
-            reason = "fallback"
-        elif est > 0:
-            reason = "affinity"
-        else:
-            # No affinity among the READY set — if a non-ready/excluded
-            # replica held the prefix, this is traffic redirected off
-            # its warm home, which an operator reads differently from
-            # plain cold load-balancing.
-            warm_elsewhere = any(
-                self.affinity and chain
-                and r.index.match_blocks(chain) > 0
-                for r in self.replicas.values()
-                if not r.ready or r.name in excluded)
-            reason = "fallback" if warm_elsewhere else "load"
-        self.decisions[reason] += 1
-        if self._m_decisions is not None:
-            self._m_decisions.labels(reason=reason).inc()
-            self._m_hit_est.observe(est)
-        return RouteDecision(replica=best.name, reason=reason,
-                             est_hit_tokens=est, candidates=len(ready))
 
     # ------------------------------------------------------------- views
     def ready_replicas(self) -> List[str]:
-        return sorted(r.name for r in self.replicas.values() if r.ready)
+        with self._lock:
+            return sorted(r.name for r in self.replicas.values()
+                          if r.ready)
 
     def stats(self) -> dict:
-        return {
-            "affinity": self.affinity,
-            "page": self.page,
-            "index_cap": self.index_cap,
-            "load_weight": self.load_weight,
-            "brownout_weight": self.brownout_weight,
-            "decisions": dict(self.decisions),
-            "replicas": {
-                r.name: {
-                    "ready": r.ready,
-                    "role": r.role,
-                    "reason": r.reason,
-                    "queued": r.queued,
-                    "active": r.active,
-                    "brownout": r.brownout,
-                    "retry_after_s": r.retry_after_s,
-                    "index_digests": len(r.index),
-                    "age_s": (round(time.monotonic() - r.last_update_t, 6)
-                              if r.last_update_t else None),
-                } for r in self.replicas.values()
-            },
-        }
+        with self._lock:
+            return {
+                "affinity": self.affinity,
+                "page": self.page,
+                "index_cap": self.index_cap,
+                "load_weight": self.load_weight,
+                "brownout_weight": self.brownout_weight,
+                "decisions": dict(self.decisions),
+                "replicas": {
+                    r.name: {
+                        "ready": r.ready,
+                        "role": r.role,
+                        "reason": r.reason,
+                        "queued": r.queued,
+                        "active": r.active,
+                        "brownout": r.brownout,
+                        "retry_after_s": r.retry_after_s,
+                        "index_digests": len(r.index),
+                        "age_s": (round(
+                            time.monotonic() - r.last_update_t, 6)
+                            if r.last_update_t else None),
+                    } for r in self.replicas.values()
+                },
+            }

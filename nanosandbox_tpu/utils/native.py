@@ -50,6 +50,11 @@ def get_lib() -> ctypes.CDLL | None:
         if not os.path.exists(_LIB_PATH) or (
                 os.path.exists(_SRC)
                 and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            # lockcheck: disable=blocking-under-lock -- build-once by
+            # design: the double-checked _lock exists precisely so ONE
+            # thread compiles the .so while every other caller waits
+            # rather than racing g++ over the same output file; cold
+            # path, runs at most once per process.
             if not os.path.exists(_SRC) or not _build():
                 _load_failed = True
                 return None

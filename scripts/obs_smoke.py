@@ -106,10 +106,27 @@ def main() -> int:
                                                      load_budget)
     from nanosandbox_tpu.obs import global_registry
 
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     export_manifest_metrics(
-        load_budget(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "budgets", "serve_cpu8.json")),
+        load_budget(os.path.join(repo_root, "budgets", "serve_cpu8.json")),
         global_registry())
+    # The concurrency-analysis twin (ISSUE 18): the lockcheck report
+    # over the package tree and a seeded schedule-fuzz run both ride
+    # /metrics, so a scrape shows the host-concurrency posture next to
+    # the comms budget.
+    from nanosandbox_tpu.analysis.lockcheck import (analyze_paths,
+                                                    export_report_metrics,
+                                                    load_lock_order)
+    from nanosandbox_tpu.utils import schedcheck
+
+    order_file = os.path.join(repo_root, "budgets", "lock_order.json")
+    export_report_metrics(
+        analyze_paths([os.path.join(repo_root, "nanosandbox_tpu")],
+                      lock_order=load_lock_order(order_file)),
+        global_registry())
+    fuzz = schedcheck.fuzz_router(0, order=schedcheck.load_order(order_file))
+    fuzz.assert_clean()
+    fuzz.export_metrics(global_registry())
     # Host-health gauges the deployment registers at startup.
     from nanosandbox_tpu.obs import register_process_vitals
 
@@ -163,6 +180,15 @@ def main() -> int:
         assert "shardcheck_collectives_total" in types, sorted(types)
         assert 'shardcheck_collectives_total{program="decode",' \
             in text, "decode gauge missing from exposition"
+        # The concurrency posture is on the scrape too: a clean
+        # lockcheck tree and a violation-free schedule-fuzz run.
+        assert "lockcheck_findings_total" in types, sorted(types)
+        assert 'lockcheck_findings_total{rule="none"} 0' in text, \
+            "lockcheck tree not clean (or export missing)"
+        assert "schedcheck_violations_total" in types, sorted(types)
+        assert "schedcheck_violations_total 0" in text, \
+            "schedule fuzz recorded violations"
+        assert "schedcheck_acquires_total" in types, sorted(types)
 
         trace = json.loads(get(f"/trace?rid={rid}"))
         validate_chrome_trace(trace)
